@@ -1,0 +1,42 @@
+"""Core type aliases, sentinels and enums.
+
+TPU-native analog of the reference's include/ps/base.h (Key/Clock/sentinels,
+MgmtTechniques) — see SURVEY.md §2.2.
+"""
+from __future__ import annotations
+
+import enum
+
+import numpy as np
+
+# Keys are int64 (reference base.h: Key = uint64_t by default; bindings require
+# int64_t). numpy/JAX index arrays use int32 on device where key counts permit.
+Key = np.int64
+Clock = int
+
+# Sentinels (reference include/ps/base.h)
+CLOCK_MAX: Clock = 2**31 - 1          # "forever" intent end
+WORKER_FINISHED: Clock = CLOCK_MAX    # worker clock value after Finalize
+LOCAL = -1                            # op timestamp: answered entirely locally
+
+# Addressbook sentinels
+NOT_CACHED = -2                       # location cache: no cached location
+NO_SLOT = -1                          # key has no slot in a pool
+
+
+class MgmtTechniques(enum.Enum):
+    """Which adaptive management actions the planner may take.
+
+    Mirrors the reference `--sys.techniques {all,replication_only,relocation_only}`
+    (coloc_kv_server.h:209, sync_manager.h:624-644).
+    """
+
+    ALL = "all"
+    REPLICATION_ONLY = "replication_only"
+    RELOCATION_ONLY = "relocation_only"
+
+
+class OpType(enum.Enum):
+    PULL = "pull"
+    PUSH = "push"
+    SET = "set"

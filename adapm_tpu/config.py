@@ -1,0 +1,105 @@
+"""System configuration.
+
+One dataclass replaces the reference's three config tiers (env vars + boost
+program_options `--sys.*` + compile-time defines; SURVEY.md §5 "Config / flag
+system"). `SystemOptions.add_arguments`/`from_args` provide the `--sys.*` CLI
+surface so apps keep the reference's flag names.
+"""
+from __future__ import annotations
+
+import argparse
+import dataclasses
+from typing import Optional
+
+from .base import MgmtTechniques
+
+
+@dataclasses.dataclass
+class SystemOptions:
+    """Knobs for the parameter manager (reference coloc_kv_server.h:205-222,
+    sync_manager.h:805-814, sampling.h:163-172)."""
+
+    # -- management techniques (sys.techniques)
+    techniques: MgmtTechniques = MgmtTechniques.ALL
+    # -- channels (sys.channels): number of independent sync streams. On TPU the
+    #    sync program is a single fused collective per round; channels partition
+    #    keys so each round can sync a subset (bounding per-round payload).
+    channels: int = 4
+    # -- location caches (sys.location_caches): keep per-host stale owner hints
+    location_caches: bool = True
+    # -- intent action timing (sys.time_intent_actions): ActionTimer on/off
+    time_intent_actions: bool = True
+
+    # -- sync throttling (sys.sync.*)
+    sync_max_per_sec: float = 1000.0
+    sync_pause_ms: float = 0.0
+    sync_threshold: float = 0.0      # drop deltas with max-abs below threshold
+
+    # -- ActionTimer (sys.timing.*; reference sync_manager.h:62-158)
+    timing_alpha: float = 0.1
+    timing_quantile: float = 0.9999
+    timing_rounds_lookahead: float = 2.0
+
+    # -- store geometry
+    cache_slots_per_shard: int = 0   # 0 = auto (num_keys // num_shards)
+    remote_bucket_min: int = 8       # min padded size of the remote op bucket
+
+    # -- observability (sys.stats.*, sys.trace.*)
+    stats_out: Optional[str] = None
+    trace_keys: Optional[str] = None
+
+    # -- sampling (--sampling.*)
+    sampling_scheme: str = "local"   # naive | preloc | pool | local
+    sampling_reuse_factor: int = 32  # pool scheme
+    sampling_pool_size: int = 0      # pool scheme; 0 = auto
+    sampling_batch_size: int = 1024  # RNG batching
+    sampling_with_replacement: bool = True
+
+    @staticmethod
+    def add_arguments(parser: argparse.ArgumentParser) -> None:
+        g = parser.add_argument_group("system")
+        g.add_argument("--sys.techniques", dest="sys_techniques", default="all",
+                       choices=[t.value for t in MgmtTechniques])
+        g.add_argument("--sys.channels", dest="sys_channels", type=int, default=4)
+        g.add_argument("--sys.location_caches", dest="sys_location_caches",
+                       type=int, default=1)
+        g.add_argument("--sys.time_intent_actions", dest="sys_time_intent_actions",
+                       type=int, default=1)
+        g.add_argument("--sys.sync.max_per_sec", dest="sys_sync_max_per_sec",
+                       type=float, default=1000.0)
+        g.add_argument("--sys.sync.pause", dest="sys_sync_pause", type=float,
+                       default=0.0)
+        g.add_argument("--sys.sync.threshold", dest="sys_sync_threshold",
+                       type=float, default=0.0)
+        g.add_argument("--sys.stats.out", dest="sys_stats_out", default=None)
+        g.add_argument("--sys.trace.keys", dest="sys_trace_keys", default=None)
+        s = parser.add_argument_group("sampling")
+        s.add_argument("--sampling.scheme", dest="sampling_scheme", default="local",
+                       choices=["naive", "preloc", "pool", "local"])
+        s.add_argument("--sampling.reuse", dest="sampling_reuse", type=int,
+                       default=32)
+        s.add_argument("--sampling.pool_size", dest="sampling_pool_size", type=int,
+                       default=0)
+        s.add_argument("--sampling.batch_size", dest="sampling_batch_size",
+                       type=int, default=1024)
+        s.add_argument("--sampling.without_replacement",
+                       dest="sampling_without_replacement", action="store_true")
+
+    @classmethod
+    def from_args(cls, args: argparse.Namespace) -> "SystemOptions":
+        return cls(
+            techniques=MgmtTechniques(args.sys_techniques),
+            channels=args.sys_channels,
+            location_caches=bool(args.sys_location_caches),
+            time_intent_actions=bool(args.sys_time_intent_actions),
+            sync_max_per_sec=args.sys_sync_max_per_sec,
+            sync_pause_ms=args.sys_sync_pause,
+            sync_threshold=args.sys_sync_threshold,
+            stats_out=args.sys_stats_out,
+            trace_keys=args.sys_trace_keys,
+            sampling_scheme=args.sampling_scheme,
+            sampling_reuse_factor=args.sampling_reuse,
+            sampling_pool_size=args.sampling_pool_size,
+            sampling_batch_size=args.sampling_batch_size,
+            sampling_with_replacement=not args.sampling_without_replacement,
+        )

@@ -1,0 +1,1 @@
+from .kv import Server, Worker  # noqa: F401

@@ -1,0 +1,130 @@
+"""Host-side ownership metadata: the reference's Addressbook reborn.
+
+Per key the reference tracks (addressbook.h):
+  - manager (home) shard = key % S              (addressbook.h:110-112)
+  - current owner (dense vector at the manager)  (addressbook.h:151)
+  - relocation counters to reject stale updates  (addressbook.h:92-102)
+  - optional location cache                      (addressbook.h:114-133)
+
+In the single-controller TPU design the addressbook is a set of host numpy
+tables shared by the planner and every local worker (one authoritative copy
+per controller process, so the manager/owner/location-cache distinction
+collapses locally; across hosts the control plane keeps them consistent). It
+additionally owns slot allocation: every key maps to a (shard, slot) row in
+its length class's device pool, and replicas map to (shard, cache slot).
+
+Keys may have different value lengths (reference `get_len`,
+coloc_kv_server_handle.h:996-999); keys are grouped into *length classes*,
+each backed by its own pooled store, so `slot` is a row index within the
+key's class pool.
+"""
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import numpy as np
+
+from ..base import NO_SLOT
+
+
+class SlotAllocator:
+    """Per-shard free-list over pool slots (LIFO for allocation locality)."""
+
+    def __init__(self, num_shards: int, slots_per_shard: int):
+        self.num_shards = num_shards
+        self.slots_per_shard = slots_per_shard
+        self._free: List[List[int]] = [
+            list(range(slots_per_shard - 1, -1, -1)) for _ in range(num_shards)
+        ]
+
+    def alloc(self, shard: int) -> int:
+        free = self._free[shard]
+        if not free:
+            raise RuntimeError(
+                f"shard {shard} out of pool slots ({self.slots_per_shard}); "
+                "increase the pool over-allocation factor")
+        return free.pop()
+
+    def free(self, shard: int, slot: int) -> None:
+        self._free[shard].append(slot)
+
+    def num_free(self, shard: int) -> int:
+        return len(self._free[shard])
+
+
+class Addressbook:
+    """Global key → location tables over all length classes."""
+
+    def __init__(self, key_class: np.ndarray, num_shards: int,
+                 main_slots: Sequence[int], cache_slots: Sequence[int]):
+        num_keys = len(key_class)
+        num_classes = len(main_slots)
+        self.num_keys = num_keys
+        self.num_shards = num_shards
+        self.key_class = key_class.astype(np.int32)
+        # main copy location: owner shard + slot within the class pool
+        self.owner = np.empty(num_keys, dtype=np.int32)
+        self.slot = np.full(num_keys, NO_SLOT, dtype=np.int32)
+        # replica locations: cache_slot[shard, key] = class-pool cache slot
+        self.cache_slot = np.full((num_shards, num_keys), NO_SLOT,
+                                  dtype=np.int32)
+        self.replica_count = np.zeros(num_keys, dtype=np.int32)
+        # bumped on every ownership move; rejects stale location info in the
+        # multi-host control plane (reference addressbook.h:92-102)
+        self.relocation_counter = np.zeros(num_keys, dtype=np.int64)
+
+        self.main_alloc = [SlotAllocator(num_shards, m) for m in main_slots]
+        self.cache_alloc = [SlotAllocator(num_shards, c) for c in cache_slots]
+
+        # initial allocation: home shard = key % S (addressbook.h:110-112)
+        for k in range(num_keys):
+            h = k % num_shards
+            self.owner[k] = h
+            self.slot[k] = self.main_alloc[self.key_class[k]].alloc(h)
+
+    # -- queries ------------------------------------------------------------
+    def home(self, key: int) -> int:
+        return int(key) % self.num_shards
+
+    def is_local(self, keys: np.ndarray, shard: int) -> np.ndarray:
+        """True per key if shard holds the main copy or a replica."""
+        return (self.owner[keys] == shard) | (
+            self.cache_slot[shard, keys] != NO_SLOT)
+
+    def has_replica(self, keys: np.ndarray, shard: int) -> np.ndarray:
+        return self.cache_slot[shard, keys] != NO_SLOT
+
+    def replica_shards(self, key: int) -> np.ndarray:
+        return np.nonzero(self.cache_slot[:, key] != NO_SLOT)[0]
+
+    # -- replica bookkeeping -------------------------------------------------
+    def add_replica(self, key: int, shard: int) -> int:
+        assert self.cache_slot[shard, key] == NO_SLOT
+        cs = self.cache_alloc[self.key_class[key]].alloc(shard)
+        self.cache_slot[shard, key] = cs
+        self.replica_count[key] += 1
+        return cs
+
+    def drop_replica(self, key: int, shard: int) -> int:
+        cs = int(self.cache_slot[shard, key])
+        assert cs != NO_SLOT
+        self.cache_slot[shard, key] = NO_SLOT
+        self.replica_count[key] -= 1
+        self.cache_alloc[self.key_class[key]].free(shard, cs)
+        return cs
+
+    # -- relocation ----------------------------------------------------------
+    def relocate(self, key: int, new_shard: int) -> tuple[int, int, int]:
+        """Move ownership of `key` to `new_shard`. Returns
+        (old_shard, old_slot, new_slot); the device row move is the caller's
+        job (Server.relocate). Host metadata only."""
+        old_shard = int(self.owner[key])
+        old_slot = int(self.slot[key])
+        assert old_shard != new_shard
+        alloc = self.main_alloc[self.key_class[key]]
+        new_slot = alloc.alloc(new_shard)
+        self.owner[key] = new_shard
+        self.slot[key] = new_slot
+        alloc.free(old_shard, old_slot)
+        self.relocation_counter[key] += 1
+        return old_shard, old_slot, new_slot

@@ -1,0 +1,580 @@
+"""The user-facing parameter-manager API: Server + Worker.
+
+API parity with the reference's ColoKVServer / ColoKVWorker
+(include/ps/coloc_kv_server.h, include/ps/coloc_kv_worker.h): Pull / Push /
+Set / PullIfLocal / Intent / PrepareSample / PullSample / Wait / WaitAll /
+WaitSync / IsFinished / advanceClock / Barrier / BeginSetup / EndSetup /
+Finalize, with the reference's async contract: ops return a timestamp,
+`Wait(ts)` blocks, and `-1` means "answered entirely locally, nothing to wait
+for" (coloc_kv_worker.h:120-186).
+
+Design notes (see ARCHITECTURE.md):
+  - Workers are logical application threads mapped onto mesh devices
+    (worker w -> shard w % S), mirroring the reference's co-located
+    worker/server process model.
+  - Values are flat float buffers with per-key lengths (reference per-key
+    `value_lengths`, coloc_kv_server.h:76); uniform-length calls may pass/get
+    2-D [B, L] arrays.
+  - The async contract maps onto JAX's async dispatch: an op enqueues device
+    programs and returns; Wait materializes results (device->host copy for
+    pulls, block_until_ready for pushes).
+  - A single coarse lock serializes table+pool mutation (the reference's
+    16384-mutex array is unnecessary: ops are batched programs, not per-key
+    critical sections).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import numpy as np
+
+from ..base import CLOCK_MAX, LOCAL, WORKER_FINISHED, MgmtTechniques
+from ..config import SystemOptions
+from ..parallel.mesh import MeshContext, get_mesh_context
+from .addressbook import Addressbook
+from .store import OOB, ShardedStore
+from .sync import SyncManager
+
+
+class _WaitEntry:
+    __slots__ = ("groups", "out", "is_write", "keys")
+
+    def __init__(self, groups=None, out=None, is_write=False, keys=None):
+        # groups: list of (class_id, row_positions, key_lengths_slice,
+        #                  device_vals, n)
+        self.groups = groups or []
+        self.out = out
+        self.is_write = is_write  # push/set: wait = block on current pools
+        self.keys = keys
+
+
+class Server:
+    """Owns the sharded pools, addressbook, planner, and worker registry.
+
+    Reference ColoKVServer (coloc_kv_server.h:58-354). `value_lengths` may be
+    a scalar (uniform) or a per-key array; keys are grouped into length
+    classes, each with its own pooled store.
+    """
+
+    def __init__(self, num_keys: int,
+                 value_lengths: Union[int, Sequence[int]],
+                 opts: Optional[SystemOptions] = None,
+                 ctx: Optional[MeshContext] = None,
+                 num_workers: Optional[int] = None,
+                 dtype=None):
+        import jax.numpy as jnp
+        self.opts = opts or SystemOptions()
+        self.ctx = ctx or get_mesh_context()
+        self.num_keys = int(num_keys)
+        self.dtype = dtype or jnp.float32
+
+        lens = np.asarray(value_lengths)
+        if lens.ndim == 0:
+            lens = np.full(self.num_keys, int(lens), dtype=np.int64)
+        assert len(lens) == self.num_keys
+        self.value_lengths = lens.astype(np.int64)
+        self.val_offsets = np.zeros(self.num_keys + 1, dtype=np.int64)
+        np.cumsum(self.value_lengths, out=self.val_offsets[1:])
+
+        # length classes
+        uniq = np.unique(self.value_lengths)
+        self.class_lengths = [int(u) for u in uniq]
+        len_to_class = {L: i for i, L in enumerate(self.class_lengths)}
+        key_class = np.array([len_to_class[int(l)] for l in self.value_lengths],
+                             dtype=np.int32)
+        class_counts = np.bincount(key_class, minlength=len(uniq))
+
+        self.stores: List[ShardedStore] = []
+        for cid, L in enumerate(self.class_lengths):
+            self.stores.append(ShardedStore(
+                int(class_counts[cid]), L, self.ctx, dtype=self.dtype,
+                cache_slots_per_shard=self.opts.cache_slots_per_shard))
+        self.ab = Addressbook(
+            key_class, self.ctx.num_shards,
+            [s.main_slots for s in self.stores],
+            [s.cache_slots for s in self.stores])
+
+        self.num_shards = self.ctx.num_shards
+        self.max_workers = num_workers or max(self.num_shards, 1)
+        self._workers: Dict[int, "Worker"] = {}
+        self._clocks = np.zeros(self.max_workers, dtype=np.int64)
+        self._lock = threading.RLock()
+        self._in_setup = False
+        # bumped whenever placement changes (replica add/drop, relocation);
+        # consumers (LocalSampling) use it to invalidate local-key caches
+        self.topology_version = 0
+
+        self.sync = SyncManager(self, self.opts)
+        self._sync_thread: Optional[threading.Thread] = None
+        self._sync_stop = threading.Event()
+
+        self.sampling = None  # set by enable_sampling_support
+
+    # -- worker management ---------------------------------------------------
+
+    def make_worker(self, worker_id: Optional[int] = None) -> "Worker":
+        with self._lock:
+            if worker_id is None:
+                worker_id = len(self._workers)
+            assert worker_id < self.max_workers, (
+                f"worker_id {worker_id} >= num_workers {self.max_workers}")
+            w = Worker(self, worker_id)
+            self._workers[worker_id] = w
+            return w
+
+    def workers(self):
+        return list(self._workers.values())
+
+    def worker_clocks(self) -> np.ndarray:
+        return self._clocks.copy()
+
+    def shard_min_clocks(self) -> np.ndarray:
+        """Min clock over the workers mapped to each shard (used for intent
+        expiry; reference compares per-customer clocks, handle.h:542-578)."""
+        out = np.full(self.num_shards, np.iinfo(np.int64).max)
+        for wid, w in self._workers.items():
+            out[w.shard] = min(out[w.shard], self._clocks[wid])
+        out[out == np.iinfo(np.int64).max] = 0
+        return out
+
+    # -- sampling ------------------------------------------------------------
+
+    def enable_sampling_support(self, sample_key_fn, min_key: int = 0,
+                                max_key: Optional[int] = None) -> None:
+        """Install a sampling scheme (reference
+        ColoKVServer::enable_sampling_support, coloc_kv_server.h;
+        `sample_key_fn(n, rng) -> np.ndarray[int64]` draws app-distribution
+        keys, like the reference's `Key sample_key()` callback)."""
+        from .sampling import make_sampling
+        self.sampling = make_sampling(self, sample_key_fn, min_key,
+                                      max_key if max_key is not None
+                                      else self.num_keys)
+
+    # -- routing helpers (host) ---------------------------------------------
+
+    def _group_by_class(self, keys: np.ndarray):
+        """Split a key batch by length class; returns [(cid, positions)]."""
+        kc = self.ab.key_class[keys]
+        if len(self.stores) == 1:
+            return [(0, np.arange(len(keys)))]
+        return [(cid, np.nonzero(kc == cid)[0])
+                for cid in np.unique(kc)]
+
+    def _flat_parts(self, keys: np.ndarray, flat: np.ndarray, positions,
+                    length: int) -> np.ndarray:
+        """Extract [n, L] rows for `positions` of `keys` out of a flat
+        concatenated value buffer (offsets are relative to this batch)."""
+        lens = self.value_lengths[keys]
+        offs = np.zeros(len(keys) + 1, dtype=np.int64)
+        np.cumsum(lens, out=offs[1:])
+        rows = np.empty((len(positions), length), dtype=flat.dtype)
+        for i, p in enumerate(positions):
+            o = offs[p]
+            rows[i] = flat[o:o + length]
+        return rows
+
+    # -- core ops (called by Worker; all under the server lock) --------------
+
+    def _pull(self, keys: np.ndarray, shard: int):
+        """Returns (groups, n_remote): one gather per length class."""
+        ab = self.ab
+        groups = []
+        n_remote = 0
+        for cid, pos in self._group_by_class(keys):
+            ks = keys[pos]
+            o_sh = ab.owner[ks].astype(np.int32)
+            o_sl = ab.slot[ks].astype(np.int32)
+            cs = ab.cache_slot[shard, ks].astype(np.int32)
+            use_c = cs >= 0
+            n_remote += int((~(use_c | (o_sh == shard))).sum())
+            c_sh = np.full_like(o_sh, shard)
+            c_sl = np.where(use_c, cs, OOB).astype(np.int32)
+            o_sl = np.where(use_c, OOB, o_sl).astype(np.int32)
+            vals = self.stores[cid].gather(o_sh, o_sl, c_sh, c_sl, use_c)
+            groups.append((cid, pos, self.value_lengths[ks], vals, len(ks)))
+        return groups, n_remote
+
+    def _push(self, keys: np.ndarray, vals: np.ndarray, shard: int,
+              is_set: bool = False) -> int:
+        ab = self.ab
+        flat = vals.ndim == 1
+        n_remote = 0
+        for cid, pos in self._group_by_class(keys):
+            ks = keys[pos]
+            L = self.class_lengths[cid]
+            if flat:
+                rows = self._flat_parts(keys, vals, pos, L)
+            else:
+                rows = vals[pos]
+            o_sh = ab.owner[ks].astype(np.int32)
+            o_sl = ab.slot[ks].astype(np.int32)
+            cs = ab.cache_slot[shard, ks].astype(np.int32)
+            use_c = cs >= 0
+            c_sh = np.full_like(o_sh, shard)
+            if is_set:
+                # Set writes through to the main copy and refreshes the
+                # writer's local replica (store._set_rows docstring)
+                n_remote += int((o_sh != shard).sum())
+                c_sl = np.where(use_c, cs, OOB).astype(np.int32)
+                self.stores[cid].set_rows(o_sh, o_sl, rows, c_sh, c_sl)
+            else:
+                n_remote += int((~(use_c | (o_sh == shard))).sum())
+                d_sl = np.where(use_c, cs, OOB).astype(np.int32)
+                o_sl = np.where(use_c, OOB, o_sl).astype(np.int32)
+                self.stores[cid].scatter_add(o_sh, o_sl, c_sh, d_sl, rows)
+        return n_remote
+
+    # -- planner ops (called by SyncManager) ---------------------------------
+
+    def _create_replicas(self, keys: np.ndarray, shard: int) -> List[int]:
+        """Allocate+materialize replicas on `shard`; returns created keys."""
+        with self._lock:
+            ab = self.ab
+            mask = ~ab.is_local(keys, shard)
+            todo = np.unique(keys[mask])
+            if len(todo) == 0:
+                return []
+            for cid, pos in self._group_by_class(todo):
+                ks = todo[pos]
+                c_sl = np.array([ab.add_replica(int(k), shard) for k in ks],
+                                dtype=np.int32)
+                o_sh = ab.owner[ks].astype(np.int32)
+                o_sl = ab.slot[ks].astype(np.int32)
+                c_sh = np.full_like(o_sh, shard)
+                self.stores[cid].replica_create(o_sh, o_sl, c_sh, c_sl)
+            self.topology_version += 1
+            return [int(k) for k in todo]
+
+    def _sync_replicas(self, items: List[Tuple[int, int]]) -> None:
+        with self._lock:
+            ab = self.ab
+            karr = np.array([k for k, _ in items], dtype=np.int64)
+            sarr = np.array([s for _, s in items], dtype=np.int32)
+            for cid, pos in self._group_by_class(karr):
+                ks, ss = karr[pos], sarr[pos]
+                r_cs = ab.cache_slot[ss, ks].astype(np.int32)
+                o_sh = ab.owner[ks].astype(np.int32)
+                o_sl = ab.slot[ks].astype(np.int32)
+                self.stores[cid].sync_replicas(ss, r_cs, o_sh, o_sl)
+
+    def _drop_replicas(self, items: List[Tuple[int, int]]) -> None:
+        with self._lock:
+            # flush pending deltas first (base refresh is harmless), then
+            # free the slots (reference readAndPotentiallyDropReplica)
+            self._sync_replicas(items)
+            for k, s in items:
+                self.ab.drop_replica(int(k), int(s))
+            self.topology_version += 1
+
+    def _relocate(self, moves: List[Tuple[int, int]]) -> None:
+        with self._lock:
+            ab = self.ab
+            moves = [(int(k), int(s)) for k, s in moves
+                     if int(s) != int(ab.owner[int(k)])]
+            if not moves:
+                return
+            karr = np.array([k for k, _ in moves], dtype=np.int64)
+            sarr = np.array([s for _, s in moves], dtype=np.int32)
+            for cid, pos in self._group_by_class(karr):
+                old_sh, old_sl, new_sl, rc_sh, rc_sl = [], [], [], [], []
+                for k, s in zip(karr[pos], sarr[pos]):
+                    k, s = int(k), int(s)
+                    cs = int(ab.cache_slot[s, k])
+                    if cs >= 0:
+                        rc_sh.append(s); rc_sl.append(cs)
+                        ab.drop_replica(k, s)
+                        self.sync.replicas[self.sync._chan(k)].discard((k, s))
+                    else:
+                        rc_sh.append(0); rc_sl.append(int(OOB))
+                    osh, osl, nsl = ab.relocate(k, s)
+                    old_sh.append(osh); old_sl.append(osl); new_sl.append(nsl)
+                self.stores[cid].relocate_rows(
+                    np.array(old_sh, np.int32), np.array(old_sl, np.int32),
+                    sarr[pos], np.array(new_sl, np.int32),
+                    np.array(rc_sh, np.int32), np.array(rc_sl, np.int32))
+            self.topology_version += 1
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start_sync_thread(self) -> None:
+        """Run sync rounds in the background (reference SyncManager threads,
+        coloc_kv_server.h:100-105). Optional: tests drive rounds manually."""
+        if self._sync_thread is not None:
+            return
+        self._sync_stop.clear()
+
+        def loop():
+            while not self._sync_stop.is_set():
+                self.sync.run_round()
+
+        self._sync_thread = threading.Thread(target=loop, daemon=True,
+                                             name="adapm-sync")
+        self._sync_thread.start()
+
+    def stop_sync_thread(self) -> None:
+        if self._sync_thread is None:
+            return
+        self._sync_stop.set()
+        self._sync_thread.join()
+        self._sync_thread = None
+
+    def barrier(self) -> None:
+        """Process barrier. Single-controller: flush dispatch. Multi-host:
+        control-plane barrier (parallel/control.py)."""
+        self.block()
+
+    def block(self) -> None:
+        for s in self.stores:
+            s.block()
+
+    def shutdown(self) -> None:
+        self.stop_sync_thread()
+        self.block()
+
+    def wait_sync(self) -> None:
+        """Act on all signalled intents and complete a full sync round
+        (reference WaitSync, coloc_kv_worker.h:517)."""
+        with self._lock:
+            self.sync.run_round(force_intents=True, all_channels=True)
+        self.block()
+
+    def quiesce(self) -> None:
+        with self._lock:
+            self.sync.quiesce()
+
+    def read_main(self, keys) -> np.ndarray:
+        """Debug/test: read current main-copy values (flat concat)."""
+        keys = np.asarray(keys, dtype=np.int64)
+        with self._lock:
+            groups, _ = self._pull_main_only(keys)
+        return self._assemble_flat(keys, groups)
+
+    def _pull_main_only(self, keys: np.ndarray):
+        ab = self.ab
+        groups = []
+        for cid, pos in self._group_by_class(keys):
+            ks = keys[pos]
+            o_sh = ab.owner[ks].astype(np.int32)
+            o_sl = ab.slot[ks].astype(np.int32)
+            n = len(ks)
+            vals = self.stores[cid].gather(
+                o_sh, o_sl, np.zeros(n, np.int32),
+                np.full(n, OOB, np.int32), np.zeros(n, bool))
+            groups.append((cid, pos, self.value_lengths[ks], vals, n))
+        return groups, 0
+
+    def _assemble_flat(self, keys: np.ndarray, groups) -> np.ndarray:
+        total = int(self.val_offsets[keys + 1].sum()
+                    - self.val_offsets[keys].sum())
+        out = np.empty(total, dtype=np.float32)
+        # per-key offset within the output buffer
+        lens = self.value_lengths[keys]
+        offs = np.zeros(len(keys) + 1, dtype=np.int64)
+        np.cumsum(lens, out=offs[1:])
+        for cid, pos, klens, vals, n in groups:
+            host = np.asarray(vals)[:n]
+            L = self.class_lengths[cid]
+            for i, p in enumerate(pos):
+                out[offs[p]:offs[p] + L] = host[i]
+        return out
+
+
+class Worker:
+    """Reference ColoKVWorker (coloc_kv_worker.h). One per logical worker;
+    mapped to mesh shard `worker_id % num_shards` (co-location)."""
+
+    def __init__(self, server: Server, worker_id: int):
+        self.server = server
+        self.worker_id = worker_id
+        self.shard = worker_id % server.num_shards
+        self._clock = 0
+        self._ts = 0
+        self._pending: Dict[int, _WaitEntry] = {}
+        from .intent import IntentQueue
+        self._intent_queue = IntentQueue()
+        # locality stats (reference coloc_kv_server.h:147-157)
+        self.stats = {"pull_ops": 0, "pull_ops_local": 0,
+                      "pull_params": 0, "pull_params_local": 0,
+                      "push_ops": 0, "push_ops_local": 0,
+                      "push_params": 0, "push_params_local": 0}
+
+    # -- value plumbing ------------------------------------------------------
+
+    def _keys(self, keys) -> np.ndarray:
+        return np.ascontiguousarray(np.asarray(keys, dtype=np.int64).ravel())
+
+    def _new_ts(self, entry: _WaitEntry) -> int:
+        self._ts += 1
+        self._pending[self._ts] = entry
+        return self._ts
+
+    # -- API: Pull / Push / Set ----------------------------------------------
+
+    def pull(self, keys, out: Optional[np.ndarray] = None) -> int:
+        """Async pull. Returns ts (use wait) or LOCAL=-1 if every key was
+        served from this worker's shard (owned or replicated) — in that case
+        `out` is already filled when provided."""
+        keys = self._keys(keys)
+        srv = self.server
+        with srv._lock:
+            groups, n_remote = srv._pull(keys, self.shard)
+        self.stats["pull_ops"] += 1
+        self.stats["pull_params"] += len(keys)
+        self.stats["pull_params_local"] += len(keys) - n_remote
+        entry = _WaitEntry(groups=groups, out=out, keys=keys)
+        if n_remote == 0:
+            self.stats["pull_ops_local"] += 1
+            self._finish_pull(keys, entry)
+            return LOCAL
+        return self._new_ts(entry)
+
+    def pull_sync(self, keys) -> np.ndarray:
+        """Pull and materialize; returns flat values (or [B, L] when the
+        batch is single-class and `reshape` fits)."""
+        keys = self._keys(keys)
+        ts = self.pull(keys)
+        if ts == LOCAL:
+            flat = self._last_result
+        else:
+            flat = self.wait(ts)
+        lens = self.server.value_lengths[keys]
+        if len(np.unique(lens)) == 1:
+            return flat.reshape(len(keys), int(lens[0]))
+        return flat
+
+    def _finish_pull(self, keys, entry: _WaitEntry) -> np.ndarray:
+        flat = self.server._assemble_flat(keys, entry.groups)
+        if entry.out is not None:
+            np.copyto(entry.out.reshape(-1)[: len(flat)], flat)
+        self._last_result = flat
+        return flat
+
+    def pull_if_local(self, keys, out: Optional[np.ndarray] = None):
+        """Pull only if all keys are local (reference PullIfLocal,
+        coloc_kv_worker.h:352). Returns (success, values|None)."""
+        keys = self._keys(keys)
+        srv = self.server
+        with srv._lock:
+            if not bool(srv.ab.is_local(keys, self.shard).all()):
+                return False, None
+            groups, _ = srv._pull(keys, self.shard)
+        entry = _WaitEntry(groups=groups, out=out)
+        return True, self._finish_pull(keys, entry)
+
+    def push(self, keys, vals, asynchronous: bool = True) -> int:
+        """Additive push (reference Push, coloc_kv_worker.h:120). vals is a
+        flat buffer or [B, L]. Returns ts or LOCAL."""
+        keys = self._keys(keys)
+        vals = np.asarray(vals, dtype=np.float32)
+        srv = self.server
+        with srv._lock:
+            n_remote = srv._push(keys, vals, self.shard, is_set=False)
+        self.stats["push_ops"] += 1
+        self.stats["push_params"] += len(keys)
+        self.stats["push_params_local"] += len(keys) - n_remote
+        if n_remote == 0:
+            self.stats["push_ops_local"] += 1
+            return LOCAL
+        return self._new_ts(_WaitEntry(is_write=True))
+
+    def set(self, keys, vals) -> int:
+        """Overwrite values (reference Set: non-additive write)."""
+        keys = self._keys(keys)
+        vals = np.asarray(vals, dtype=np.float32)
+        srv = self.server
+        with srv._lock:
+            n_remote = srv._push(keys, vals, self.shard, is_set=True)
+        if n_remote == 0:
+            return LOCAL
+        return self._new_ts(_WaitEntry(is_write=True))
+
+    # -- API: waiting ---------------------------------------------------------
+
+    def wait(self, ts: int):
+        """Block until op `ts` is complete; for pulls returns/fills values."""
+        if ts == LOCAL:
+            return getattr(self, "_last_result", None)
+        entry = self._pending.pop(ts, None)
+        if entry is None:
+            return None
+        if entry.groups:
+            return self._finish_pull(entry.keys, entry)
+        # write op: dispatch order serializes programs on the pool buffers,
+        # so blocking on the current pools covers this op
+        self.server.block()
+        return None
+
+    def wait_all(self) -> None:
+        for ts in sorted(self._pending.keys()):
+            self.wait(ts)
+
+    def is_finished(self, ts: int) -> bool:
+        """Non-blocking completion check (reference IsFinished)."""
+        if ts == LOCAL or ts not in self._pending:
+            return True
+        entry = self._pending[ts]
+        if entry.is_write:
+            return all(s.main.is_ready() for s in self.server.stores)
+        return all(g[3].is_ready() for g in entry.groups)
+
+    def wait_sync(self) -> None:
+        self.server.wait_sync()
+
+    # -- API: intent + clock --------------------------------------------------
+
+    def intent(self, keys, start: int, end: Optional[int] = None) -> None:
+        """Declare future access to `keys` in clock window [start, end]
+        (reference Intent, coloc_kv_worker.h:380-408; end defaults to start)."""
+        keys = np.unique(self._keys(keys))
+        end = start if end is None else end
+        self._intent_queue.push(keys, int(start), int(end))
+
+    def advance_clock(self) -> int:
+        self._clock += 1
+        self.server._clocks[self.worker_id] = self._clock
+        return self._clock
+
+    @property
+    def current_clock(self) -> int:
+        return self._clock
+
+    # -- API: sampling --------------------------------------------------------
+
+    def prepare_sample(self, n: int, start: Optional[int] = None,
+                       end: Optional[int] = None) -> int:
+        """Reference PrepareSample (coloc_kv_worker.h:418): announce that this
+        worker will sample `n` keys around clock [start, end]."""
+        start = self._clock if start is None else start
+        end = start if end is None else end
+        return self.server.sampling.prepare(self, n, int(start), int(end))
+
+    def pull_sample(self, handle: int, n: Optional[int] = None):
+        """Draw n keys (default: all prepared) from sampling handle; returns
+        (keys, values[B, L])."""
+        return self.server.sampling.pull(self, handle, n)
+
+    def finish_sample(self, handle: int) -> None:
+        self.server.sampling.finish(self, handle)
+
+    # -- API: lifecycle -------------------------------------------------------
+
+    def barrier(self) -> None:
+        self.server.barrier()
+
+    def begin_setup(self) -> None:
+        """Bracket initialization (reference BeginSetup/EndSetup): sync is
+        paused so bulk Set/Push of initial values runs at full speed."""
+        self.server._in_setup = True
+
+    def end_setup(self) -> None:
+        self.server._in_setup = False
+        self.server.barrier()
+
+    def finalize(self) -> None:
+        """Mark worker finished (reference Finalize): clock to infinity so
+        its intents expire and replicas can be dropped."""
+        self.wait_all()
+        self._clock = WORKER_FINISHED
+        self.server._clocks[self.worker_id] = WORKER_FINISHED

@@ -1,0 +1,247 @@
+"""Sampling access management: PrepareSample / PullSample / FinishSample.
+
+Reference include/ps/sampling.h — the PM manages negative-sampling access so
+it can exploit locality (NuPS heritage). Four schemes (sampling.h:180-525):
+
+  naive   draw keys at prepare time, plain Pull at pull time
+  preloc  naive + Intent on the drawn keys at prepare time
+  pool    shared pool of samples, refreshed with a reuse factor
+  local   (default) draw from the app distribution, then snap to a key that
+          is *locally available* — trades exact distribution for locality
+          (documented distortion, sampling.h:361-365)
+
+On TPU the Local scheme gets cheaper than the reference's linear key probe
+(sampling.h:476-505): we keep a sorted array of locally-resident keys per
+shard and snap with np.searchsorted (binary search), refreshed lazily when
+the placement topology changes.
+
+The app supplies `sample_key_fn(n, rng) -> keys` (reference `Key
+sample_key()`), e.g. unigram^0.75 for word2vec.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from ..base import NO_SLOT
+
+
+class _Handle:
+    __slots__ = ("n", "start", "end", "keys", "pos", "seen")
+
+    def __init__(self, n: int, start: int, end: int):
+        self.n = n
+        self.start = start
+        self.end = end
+        self.keys: Optional[np.ndarray] = None  # pre-drawn (naive/preloc)
+        self.pos = 0
+        self.seen: set = set()                  # without-replacement dedup
+
+
+class SamplingBase:
+    def __init__(self, server, sample_key_fn, min_key: int, max_key: int,
+                 seed: int = 42):
+        self.server = server
+        self.sample_key_fn = sample_key_fn
+        self.min_key = min_key
+        self.max_key = max_key
+        self.opts = server.opts
+        self._rngs: Dict[int, np.random.Generator] = {}
+        self._handles: Dict[Tuple[int, int], _Handle] = {}
+        self._next_id: Dict[int, int] = {}
+        self._seed = seed
+        # per-scheme access stats (reference sampling.h:85-97)
+        self.stats = {"prepared": 0, "pulled": 0, "pulled_local": 0}
+
+    def _rng(self, worker) -> np.random.Generator:
+        wid = worker.worker_id
+        if wid not in self._rngs:
+            self._rngs[wid] = np.random.default_rng(self._seed + wid)
+        return self._rngs[wid]
+
+    def _draw(self, n: int, worker) -> np.ndarray:
+        keys = np.asarray(self.sample_key_fn(n, self._rng(worker)),
+                          dtype=np.int64)
+        return keys
+
+    def _draw_wor(self, n: int, worker, seen: set) -> np.ndarray:
+        """Draw without replacement against `seen` (rejection sampling,
+        reference draw_samples WOR, sampling.h:142-160)."""
+        out = []
+        tries = 0
+        while len(out) < n and tries < 100 * n + 100:
+            for k in self._draw(n - len(out), worker):
+                k = int(k)
+                tries += 1
+                if k not in seen:
+                    seen.add(k)
+                    out.append(k)
+        if len(out) < n:
+            raise RuntimeError("WOR sampling could not find enough keys")
+        return np.asarray(out, dtype=np.int64)
+
+    # -- public (called via Worker) -----------------------------------------
+
+    def prepare(self, worker, n: int, start: int, end: int) -> int:
+        wid = worker.worker_id
+        hid = self._next_id.get(wid, 0)
+        self._next_id[wid] = hid + 1
+        h = _Handle(n, start, end)
+        self._handles[(wid, hid)] = h
+        self._prepare(worker, h)
+        self.stats["prepared"] += n
+        return hid
+
+    def pull(self, worker, hid: int, n: Optional[int] = None):
+        h = self._handles[(worker.worker_id, hid)]
+        n = h.n - h.pos if n is None else n
+        assert h.pos + n <= h.n, "pulling more samples than prepared"
+        keys, vals = self._pull(worker, h, n)
+        h.pos += n
+        self.stats["pulled"] += n
+        return keys, vals
+
+    def finish(self, worker, hid: int) -> None:
+        self._handles.pop((worker.worker_id, hid), None)
+
+    # -- scheme hooks --------------------------------------------------------
+
+    def _prepare(self, worker, h: _Handle) -> None:
+        pass
+
+    def _pull(self, worker, h: _Handle, n: int):
+        raise NotImplementedError
+
+
+class NaiveSampling(SamplingBase):
+    """Draw at prepare, plain Pull at pull time (sampling.h:180-241)."""
+
+    def _prepare(self, worker, h: _Handle) -> None:
+        if self.opts.sampling_with_replacement:
+            h.keys = self._draw(h.n, worker)
+        else:
+            h.keys = self._draw_wor(h.n, worker, h.seen)
+
+    def _pull(self, worker, h: _Handle, n: int):
+        keys = h.keys[h.pos:h.pos + n]
+        vals = worker.pull_sync(keys)
+        return keys, vals
+
+
+class PrelocSampling(NaiveSampling):
+    """Naive + Intent on the drawn keys (sampling.h:248-280), so by pull time
+    the planner has replicated/relocated them."""
+
+    def _prepare(self, worker, h: _Handle) -> None:
+        super()._prepare(worker, h)
+        worker.intent(h.keys, h.start, h.end)
+
+
+class PoolSampling(SamplingBase):
+    """Shared pool of samples with bounded reuse (sampling.h:288-357): the
+    pool is filled from the app distribution, every entry is used at most
+    `reuse` times before being redrawn, and pool entries carry intent so the
+    planner keeps them local."""
+
+    def __init__(self, *args, **kw):
+        super().__init__(*args, **kw)
+        size = self.opts.sampling_pool_size or 4096
+        self.pool = np.zeros(size, dtype=np.int64)
+        self.uses = np.full(size, 2**31 - 1, dtype=np.int64)  # force refill
+        self.reuse = max(1, self.opts.sampling_reuse_factor)
+        self._cursor = 0
+
+    def _refill(self, worker, idx: np.ndarray) -> None:
+        fresh = self._draw(len(idx), worker)
+        self.pool[idx] = fresh
+        self.uses[idx] = 0
+        clock = worker.current_clock
+        worker.intent(fresh, clock, clock + self.reuse)
+
+    def _pull(self, worker, h: _Handle, n: int):
+        size = len(self.pool)
+        idx = (self._cursor + np.arange(n)) % size
+        self._cursor = int((self._cursor + n) % size)
+        stale = idx[self.uses[idx] >= self.reuse]
+        if len(stale):
+            self._refill(worker, stale)
+        self.uses[idx] += 1
+        keys = self.pool[idx].copy()
+        if not self.opts.sampling_with_replacement:
+            # dedup within the handle by redrawing collisions directly
+            for i, k in enumerate(keys):
+                if int(k) in h.seen:
+                    keys[i] = int(self._draw_wor(1, worker, h.seen)[0])
+                else:
+                    h.seen.add(int(k))
+        vals = worker.pull_sync(keys)
+        return keys, vals
+
+
+class LocalSampling(SamplingBase):
+    """Default scheme (sampling.h:366-525): snap each drawn key to one that
+    is locally available on the worker's shard, so sampled pulls never leave
+    the device. Uses a sorted local-key index + binary search instead of the
+    reference's linear probe."""
+
+    def __init__(self, *args, **kw):
+        super().__init__(*args, **kw)
+        self._local_keys: Dict[int, np.ndarray] = {}
+        self._topo_version = -1
+
+    def _local_index(self, shard: int) -> np.ndarray:
+        srv = self.server
+        v = srv.topology_version
+        if v != self._topo_version:
+            self._local_keys.clear()
+            self._topo_version = v
+        if shard not in self._local_keys:
+            ab = srv.ab
+            rng = np.arange(self.min_key, self.max_key, dtype=np.int64)
+            local = (ab.owner[rng] == shard) | (
+                ab.cache_slot[shard, rng] != NO_SLOT)
+            self._local_keys[shard] = rng[local]
+        return self._local_keys[shard]
+
+    def _snap(self, keys: np.ndarray, shard: int) -> np.ndarray:
+        local = self._local_index(shard)
+        if len(local) == 0:
+            return keys  # nothing local; fall back to the raw draw
+        pos = np.searchsorted(local, keys)
+        pos = np.where(pos >= len(local), 0, pos)  # wrap (sampling.h:494)
+        return local[pos]
+
+    def _pull(self, worker, h: _Handle, n: int):
+        if self.opts.sampling_with_replacement:
+            keys = self._snap(self._draw(n, worker), worker.shard)
+        else:
+            keys = np.empty(n, dtype=np.int64)
+            for i in range(n):
+                for _ in range(1000):
+                    k = int(self._snap(self._draw(1, worker),
+                                       worker.shard)[0])
+                    if k not in h.seen:
+                        break
+                    # collision: probe the next local key (WOR variant,
+                    # sampling.h:437-460)
+                    local = self._local_index(worker.shard)
+                    j = int(np.searchsorted(local, k))
+                    for step in range(1, len(local) + 1):
+                        k2 = int(local[(j + step) % len(local)])
+                        if k2 not in h.seen:
+                            k = k2
+                            break
+                    break
+                h.seen.add(k)
+                keys[i] = k
+        vals = worker.pull_sync(keys)
+        self.stats["pulled_local"] += n
+        return keys, vals
+
+
+def make_sampling(server, sample_key_fn, min_key: int, max_key: int):
+    scheme = server.opts.sampling_scheme
+    cls = {"naive": NaiveSampling, "preloc": PrelocSampling,
+           "pool": PoolSampling, "local": LocalSampling}[scheme]
+    return cls(server, sample_key_fn, min_key, max_key)

@@ -1,0 +1,207 @@
+"""Device-resident sharded parameter pools + the jitted data-plane programs.
+
+This replaces the reference's `DefaultColoServerHandle` (the node-local store
+with a 16384-mutex lock array, coloc_kv_server_handle.h) with three pooled
+`jax.Array`s sharded over the mesh "kv" axis:
+
+    main  [S, slots, L]   main copies          (owner shard holds the row)
+    cache [S, cslots, L]  replica base values  (value at last refresh)
+    delta [S, cslots, L]  additive updates accumulated against replicas
+
+No locks are needed: AdaPM's merge function is additive (reference
+handle.h:404-415), so XLA scatter-add expresses concurrent pushes exactly, and
+single-controller dispatch order serializes programs on the (donated) buffers.
+The reference's `sync_state` copy + subtraction (`val - sync_state`,
+handle.h:601-662) is replaced by *storing the delta directly*; a replica read
+returns `cache + delta`, which preserves read-your-writes.
+
+A `ShardedStore` is one uniform-value-length pool (a "length class"); routing
+from keys to (shard, slot) indices lives in Server/Addressbook. All programs
+take fixed-shape index buffers; batches are padded to power-of-two buckets and
+padding entries carry out-of-range indices so JAX's mode="drop" (scatter) and
+mode="fill" (gather) make them no-ops.
+"""
+from __future__ import annotations
+
+import math
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..parallel.mesh import MeshContext
+
+# Out-of-range slot index for padding / masked entries: dropped by scatters
+# (mode="drop"), zero-filled by gathers (mode="fill").
+OOB = np.int32(2**31 - 2)
+
+
+def bucket_size(n: int, minimum: int = 8) -> int:
+    """Pad n up to a power of two (bounds the number of compiled variants)."""
+    if n <= minimum:
+        return minimum
+    return 1 << math.ceil(math.log2(n))
+
+
+def pad_to(arr: np.ndarray, size: int, fill) -> np.ndarray:
+    out = np.full((size,) + arr.shape[1:], fill, dtype=arr.dtype)
+    out[: arr.shape[0]] = arr
+    return out
+
+
+def pad_bucket(n: int, *arrays_and_fills, minimum: int = 8):
+    b = bucket_size(n, minimum)
+    return [jnp.asarray(pad_to(a, b, fill)) for a, fill in arrays_and_fills]
+
+
+# ---------------------------------------------------------------------------
+# jitted programs (module level: jit cache shared across stores)
+# ---------------------------------------------------------------------------
+
+@jax.jit
+def _gather(main, cache, delta, o_shard, o_slot, c_shard, c_slot, use_cache):
+    """Pull: main rows for owner-served keys, cache+delta for replica-served
+    keys (o_slot is OOB for the latter to avoid pointless remote traffic)."""
+    m = main.at[o_shard, o_slot].get(mode="fill", fill_value=0)
+    c = (cache.at[c_shard, c_slot].get(mode="fill", fill_value=0)
+         + delta.at[c_shard, c_slot].get(mode="fill", fill_value=0))
+    return jnp.where(use_cache[:, None], c, m)
+
+
+@partial(jax.jit, donate_argnums=(0, 1))
+def _scatter_add(main, delta, o_shard, o_slot, d_shard, d_slot, vals):
+    """Push: each row routed either to main (owner path; d_slot=OOB) or to a
+    local replica's delta row (o_slot=OOB). Duplicate keys accumulate."""
+    main = main.at[o_shard, o_slot].add(vals, mode="drop")
+    delta = delta.at[d_shard, d_slot].add(vals, mode="drop")
+    return main, delta
+
+
+@partial(jax.jit, donate_argnums=(0, 1, 2))
+def _set_rows(main, cache, delta, o_shard, o_slot, vals, c_shard, c_slot):
+    """Set: overwrite the main copy; refresh the writer's local replica (if
+    any) and clear its pending delta so a local read observes the set value."""
+    main = main.at[o_shard, o_slot].set(vals, mode="drop")
+    cache = cache.at[c_shard, c_slot].set(vals, mode="drop")
+    delta = delta.at[c_shard, c_slot].set(jnp.zeros_like(vals), mode="drop")
+    return main, cache, delta
+
+
+@partial(jax.jit, donate_argnums=(1, 2))
+def _replica_create(main, cache, delta, o_shard, o_slot, c_shard, c_slot):
+    """Materialize replicas: copy current main rows into cache slots and zero
+    their deltas (reference registerNewIntentsForKeyUnsafe + first refresh,
+    handle.h:484-532, 776-840 — one program, since the single-controller
+    planner creates replicas synchronously)."""
+    rows = main.at[o_shard, o_slot].get(mode="fill", fill_value=0)
+    cache = cache.at[c_shard, c_slot].set(rows, mode="drop")
+    delta = delta.at[c_shard, c_slot].set(jnp.zeros_like(rows), mode="drop")
+    return cache, delta
+
+
+@partial(jax.jit, donate_argnums=(0, 1, 2))
+def _sync_replicas(main, cache, delta, r_shard, r_cslot, o_shard, o_slot):
+    """One sync round over a batch of replicas (reference SyncManager
+    startSync/ProcessSyncMessage, sync_manager.h:291-382, 553-799): extract
+    deltas -> merge into owners (scatter-add; multiple replicas of one key
+    all land) -> gather fresh values -> refresh bases, clear deltas."""
+    dvals = delta.at[r_shard, r_cslot].get(mode="fill", fill_value=0)
+    main = main.at[o_shard, o_slot].add(dvals, mode="drop")
+    fresh = main.at[o_shard, o_slot].get(mode="fill", fill_value=0)
+    cache = cache.at[r_shard, r_cslot].set(fresh, mode="drop")
+    delta = delta.at[r_shard, r_cslot].set(jnp.zeros_like(fresh), mode="drop")
+    return main, cache, delta
+
+
+@partial(jax.jit, donate_argnums=(0, 1))
+def _relocate(main, delta, old_shard, old_slot, new_shard, new_slot,
+              rc_shard, rc_slot):
+    """Relocation: move rows old->new; if the destination shard held a
+    replica, merge its pending delta (replica->owner upgrade, reference
+    refreshUpgradeReplicaUnsafe handle.h:776-840). All gathers happen before
+    all scatters, so intra-batch slot reuse is safe."""
+    rows = main.at[old_shard, old_slot].get(mode="fill", fill_value=0)
+    rows = rows + delta.at[rc_shard, rc_slot].get(mode="fill", fill_value=0)
+    main = main.at[new_shard, new_slot].set(rows, mode="drop")
+    delta = delta.at[rc_shard, rc_slot].set(jnp.zeros_like(rows), mode="drop")
+    return main, delta
+
+
+# ---------------------------------------------------------------------------
+
+
+class ShardedStore:
+    """Pools for one length class. Index-level API; key routing lives above."""
+
+    def __init__(self, num_keys_in_class: int, value_length: int,
+                 ctx: MeshContext, dtype=jnp.float32, over_alloc: float = 1.25,
+                 cache_slots_per_shard: int = 0):
+        self.value_length = value_length
+        self.ctx = ctx
+        self.dtype = dtype
+        S = ctx.num_shards
+        per_shard = max(1, math.ceil(num_keys_in_class / S))
+        self.main_slots = max(1, math.ceil(per_shard * over_alloc))
+        self.cache_slots = max(1, cache_slots_per_shard or per_shard)
+
+        sh = ctx.shard0()
+        self.main = jax.device_put(
+            jnp.zeros((S, self.main_slots, value_length), dtype), sh)
+        self.cache = jax.device_put(
+            jnp.zeros((S, self.cache_slots, value_length), dtype), sh)
+        self.delta = jax.device_put(
+            jnp.zeros((S, self.cache_slots, value_length), dtype), sh)
+
+    def _vals_bucket(self, vals, bucket: int):
+        v = jnp.zeros((bucket, self.value_length), self.dtype)
+        n = vals.shape[0]
+        return v.at[:n].set(jnp.asarray(vals, self.dtype))
+
+    # index-level ops (all index arrays are np.int32, padded by caller or
+    # padded here via pad_bucket)
+
+    def gather(self, o_shard, o_slot, c_shard, c_slot, use_cache):
+        n = len(o_shard)
+        a = pad_bucket(n, (o_shard, 0), (o_slot, OOB), (c_shard, 0),
+                       (c_slot, OOB), (use_cache, False))
+        return _gather(self.main, self.cache, self.delta, *a)
+
+    def scatter_add(self, o_shard, o_slot, d_shard, d_slot, vals):
+        n = len(o_shard)
+        a = pad_bucket(n, (o_shard, 0), (o_slot, OOB), (d_shard, 0),
+                       (d_slot, OOB))
+        v = self._vals_bucket(vals, a[0].shape[0])
+        self.main, self.delta = _scatter_add(self.main, self.delta, *a, v)
+
+    def set_rows(self, o_shard, o_slot, vals, c_shard, c_slot):
+        n = len(o_shard)
+        a = pad_bucket(n, (o_shard, 0), (o_slot, OOB), (c_shard, 0),
+                       (c_slot, OOB))
+        v = self._vals_bucket(vals, a[0].shape[0])
+        self.main, self.cache, self.delta = _set_rows(
+            self.main, self.cache, self.delta, a[0], a[1], v, a[2], a[3])
+
+    def replica_create(self, o_shard, o_slot, c_shard, c_slot):
+        n = len(o_shard)
+        a = pad_bucket(n, (o_shard, 0), (o_slot, OOB), (c_shard, 0),
+                       (c_slot, OOB))
+        self.cache, self.delta = _replica_create(
+            self.main, self.cache, self.delta, *a)
+
+    def sync_replicas(self, r_shard, r_cslot, o_shard, o_slot):
+        n = len(r_shard)
+        a = pad_bucket(n, (r_shard, 0), (r_cslot, OOB), (o_shard, 0),
+                       (o_slot, OOB))
+        self.main, self.cache, self.delta = _sync_replicas(
+            self.main, self.cache, self.delta, *a)
+
+    def relocate_rows(self, old_shard, old_slot, new_shard, new_slot,
+                      rc_shard, rc_slot):
+        n = len(old_shard)
+        a = pad_bucket(n, (old_shard, 0), (old_slot, OOB), (new_shard, 0),
+                       (new_slot, OOB), (rc_shard, 0), (rc_slot, OOB))
+        self.main, self.delta = _relocate(self.main, self.delta, *a)
+
+    def block(self) -> None:
+        jax.block_until_ready((self.main, self.cache, self.delta))

@@ -1,0 +1,215 @@
+"""The adaptive management planner: SyncManager reborn.
+
+Reference: one SyncManager thread per channel (sync_manager.h:452-520) drains
+worker intent queues, materializes replicas, extracts/ships deltas, and — on
+the owner side — decides per key whether to *relocate* the main copy to the
+requesting node or *replicate* it there (sync_manager.h:553-739, decision at
+:624-644: relocate iff no other node and no local worker has intent).
+
+Here the planner is a host-side loop (optionally a background thread) driving
+the jitted sync/relocate/replica-create programs of the ShardedStores. The
+owner/requester message exchange collapses: the single controller holds the
+authoritative tables, so a "sync round" for a channel is ONE fused device
+program per length class (delta psum -> owner merge -> fresh-value refresh)
+instead of per-destination ZeroMQ messages. Channels partition keys by the
+same Knuth multiplicative hash (reference handle.h:1016-1029) and bound the
+per-round payload.
+"""
+from __future__ import annotations
+
+import time
+from collections import defaultdict
+from typing import Dict, List, Optional, Set, Tuple
+
+import numpy as np
+
+from ..base import CLOCK_MAX, NO_SLOT, MgmtTechniques
+from .intent import ActionTimer
+
+KNUTH = np.uint64(2654435761)
+
+
+def key_channel(keys: np.ndarray, num_channels: int) -> np.ndarray:
+    """Key -> channel via Knuth multiplicative hash (handle.h:1016-1029)."""
+    h = (keys.astype(np.uint64) * KNUTH) & np.uint64(0xFFFFFFFF)
+    return (h % np.uint64(num_channels)).astype(np.int32)
+
+
+class SyncStats:
+    def __init__(self):
+        self.rounds = 0
+        self.replicas_created = 0
+        self.replicas_dropped = 0
+        self.relocations = 0
+        self.keys_synced = 0
+        self.intents_processed = 0
+
+
+class SyncManager:
+    """Plans and executes replication/relocation/sync for one Server."""
+
+    def __init__(self, server, opts):
+        self.server = server
+        self.opts = opts
+        self.num_channels = opts.channels
+        S = server.num_shards
+        K = server.num_keys
+        # per-shard registered intent horizon: max end clock of any active
+        # intent by a worker on that shard (reference: Parameter.local_intents
+        # per customer, handle.h:122-152, aggregated to the node level)
+        self.intent_end = np.full((S, K), -1, dtype=np.int64)
+        # live replicas, partitioned by channel: channel -> set[(key, shard)]
+        self.replicas: List[Set[Tuple[int, int]]] = [
+            set() for _ in range(self.num_channels)]
+        self.timer = ActionTimer(
+            server.max_workers, alpha=opts.timing_alpha,
+            quantile=opts.timing_quantile,
+            rounds_lookahead=opts.timing_rounds_lookahead,
+            enabled=opts.time_intent_actions)
+        self.stats = SyncStats()
+        self._next_channel = 0
+        self._last_round_t = 0.0
+
+    # ------------------------------------------------------------------
+    # intent registration + replicate-vs-relocate decision
+    # ------------------------------------------------------------------
+
+    def drain_intents(self, force: bool = False) -> None:
+        """Drain worker intent queues for intents starting within the
+        ActionTimer window (reference registerNewIntents,
+        sync_manager.h:257-286); force=True drains everything (WaitSync)."""
+        clocks = self.server.worker_clocks()
+        self.timer.observe(clocks)
+        window = self.timer.window()
+        relocations: List[Tuple[int, int]] = []   # (key, dest shard)
+        replications: Dict[int, List[int]] = defaultdict(list)  # shard->keys
+        for w in self.server.workers():
+            max_start = CLOCK_MAX if force else int(
+                clocks[w.worker_id] + window[w.worker_id])
+            for keys, start, end in w._intent_queue.pop_relevant(max_start):
+                self._register(w.shard, keys, end, relocations, replications)
+                self.stats.intents_processed += len(keys)
+        if relocations:
+            self.server._relocate(relocations)
+            self.stats.relocations += len(relocations)
+        for shard, keys in replications.items():
+            created = self.server._create_replicas(
+                np.asarray(keys, dtype=np.int64), shard)
+            for k in created:
+                self.replicas[self._chan(k)].add((k, shard))
+            self.stats.replicas_created += len(created)
+
+    def _chan(self, key: int) -> int:
+        return int(key_channel(np.asarray([key]), self.num_channels)[0])
+
+    def _register(self, shard: int, keys: np.ndarray, end: int,
+                  relocations, replications) -> None:
+        ab = self.server.ab
+        ie = self.intent_end
+        np.maximum.at(ie[shard], keys, end)
+        # keys that are not yet available on `shard`
+        nonlocal_mask = ~ab.is_local(keys, shard)
+        for k in keys[nonlocal_mask]:
+            k = int(k)
+            action = self._decide(k, shard)
+            if action == "relocate":
+                relocations.append((k, shard))
+            else:
+                replications[shard].append(k)
+
+    def _decide(self, key: int, shard: int) -> str:
+        """Relocate vs replicate (reference sync_manager.h:624-644): relocate
+        iff no *other* shard currently has interest in the key (an active
+        intent or a replica) — otherwise replicate."""
+        t = self.opts.techniques
+        if t == MgmtTechniques.REPLICATION_ONLY:
+            return "replicate"
+        if t == MgmtTechniques.RELOCATION_ONLY:
+            return "relocate"
+        ab = self.server.ab
+        clocks = self.server.shard_min_clocks()
+        for s in range(self.server.num_shards):
+            if s == shard:
+                continue
+            if ab.cache_slot[s, key] != NO_SLOT:
+                return "replicate"
+            if self.intent_end[s, key] >= clocks[s]:
+                # any other shard's active intent blocks relocation; the
+                # reference distinguishes owner-local intent and remote node
+                # intent but blocks relocation on either (:624-644)
+                return "replicate"
+        return "relocate"
+
+    # ------------------------------------------------------------------
+    # sync rounds
+    # ------------------------------------------------------------------
+
+    def sync_channel(self, channel: int) -> None:
+        """Refresh replicas with active intent; flush+drop expired ones
+        (reference readAndPotentiallyDropReplica, handle.h:601-662)."""
+        reps = self.replicas[channel]
+        if not reps:
+            return
+        min_clocks = self.server.shard_min_clocks()
+        keep: List[Tuple[int, int]] = []
+        drop: List[Tuple[int, int]] = []
+        for (k, s) in reps:
+            if self.intent_end[s, k] >= min_clocks[s]:
+                keep.append((k, s))
+            else:
+                drop.append((k, s))
+        if keep:
+            self.server._sync_replicas(keep)
+            self.stats.keys_synced += len(keep)
+        if drop:
+            self.server._drop_replicas(drop)
+            for item in drop:
+                reps.discard(item)
+            self.stats.replicas_dropped += len(drop)
+
+    def run_round(self, force_intents: bool = False,
+                  all_channels: bool = False) -> None:
+        self._throttle()
+        self.drain_intents(force=force_intents)
+        if all_channels:
+            for c in range(self.num_channels):
+                self.sync_channel(c)
+        else:
+            self.sync_channel(self._next_channel)
+            self._next_channel = (self._next_channel + 1) % self.num_channels
+        self.stats.rounds += 1
+
+    def _throttle(self) -> None:
+        """Bound sync frequency (reference sync_manager.h:384-411, 805-814:
+        --sys.sync.max_per_sec / --sys.sync.pause)."""
+        if self.opts.sync_pause_ms > 0:
+            time.sleep(self.opts.sync_pause_ms / 1e3)
+            return
+        if self.opts.sync_max_per_sec <= 0:
+            return
+        min_gap = 1.0 / self.opts.sync_max_per_sec
+        now = time.monotonic()
+        wait = self._last_round_t + min_gap - now
+        if wait > 0:
+            time.sleep(wait)
+        self._last_round_t = time.monotonic()
+
+    # ------------------------------------------------------------------
+
+    def quiesce(self) -> None:
+        """Force-process all intents and flush every pending delta; after this
+        all reads (from anywhere) observe identical values — the reference's
+        WaitSync + Barrier quiesce protocol (test_many_key_operations.cc)."""
+        self.drain_intents(force=True)
+        for c in range(self.num_channels):
+            reps = list(self.replicas[c])
+            if reps:
+                self.server._sync_replicas(reps)
+                self.stats.keys_synced += len(reps)
+        self.server.block()
+
+    def report(self) -> str:
+        s = self.stats
+        return (f"sync: rounds={s.rounds} intents={s.intents_processed} "
+                f"replicas+={s.replicas_created} -={s.replicas_dropped} "
+                f"relocations={s.relocations} keys_synced={s.keys_synced}")

@@ -1,0 +1,1 @@
+from .mesh import MeshContext, get_mesh_context, make_mesh  # noqa: F401

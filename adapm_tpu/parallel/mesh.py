@@ -1,0 +1,77 @@
+"""Device mesh construction and sharding helpers.
+
+Replaces the reference's process/topology bootstrap (Postoffice + Van ADD_NODE
+rendezvous, src/van.cc:267-357): on TPU the "nodes" are mesh devices, rank
+assignment is the mesh order, and the scheduler is `jax.distributed`'s
+coordinator (multi-host) or nothing (single host).
+
+The canonical mesh has one axis:
+  - "kv": parameter shards (the reference's server dimension). Data-parallel
+    workers are co-located with kv shards, mirroring the reference's co-located
+    worker+server process model (README.md:161-165).
+
+Model code may build richer meshes (e.g. ("data", "model")) on top; the KV
+store only needs "kv".
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+KV_AXIS = "kv"
+
+
+@dataclasses.dataclass
+class MeshContext:
+    mesh: Mesh
+
+    @property
+    def num_shards(self) -> int:
+        return self.mesh.shape[KV_AXIS]
+
+    @property
+    def devices(self) -> Sequence[jax.Device]:
+        return list(self.mesh.devices.flat)
+
+    def shard0(self) -> NamedSharding:
+        """Sharding for pool arrays [S, slots, L]: dim 0 over the kv axis."""
+        return NamedSharding(self.mesh, P(KV_AXIS))
+
+    def replicated(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P())
+
+
+def make_mesh(num_shards: Optional[int] = None,
+              devices: Optional[Sequence[jax.Device]] = None) -> MeshContext:
+    if devices is None:
+        # ADAPM_PLATFORM forces a backend (tests use cpu + virtual devices
+        # even when a TPU plugin claimed the default platform)
+        platform = os.environ.get("ADAPM_PLATFORM")
+        devices = jax.devices(platform) if platform else jax.devices()
+    if num_shards is None:
+        num_shards = len(devices)
+    if num_shards > len(devices):
+        raise ValueError(
+            f"requested {num_shards} shards but only {len(devices)} devices")
+    mesh = Mesh(np.asarray(devices[:num_shards]), (KV_AXIS,))
+    return MeshContext(mesh=mesh)
+
+
+_default_ctx: Optional[MeshContext] = None
+
+
+def get_mesh_context() -> MeshContext:
+    global _default_ctx
+    if _default_ctx is None:
+        _default_ctx = make_mesh()
+    return _default_ctx
+
+
+def set_mesh_context(ctx: MeshContext) -> None:
+    global _default_ctx
+    _default_ctx = ctx

@@ -1,0 +1,26 @@
+"""Test harness: multi-device without a cluster.
+
+The reference tests launch N server processes + a scheduler on localhost via
+tracker/dmlc_local.py (SURVEY.md §4). Here "multi-node" = an 8-device virtual
+CPU mesh (XLA host-platform device count), which exercises the same sharded
+programs the TPU path compiles. Must run before jax is imported anywhere.
+"""
+import os
+
+os.environ["ADAPM_PLATFORM"] = "cpu"  # force CPU even if a TPU plugin is up
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)

@@ -1,0 +1,142 @@
+"""Basic Pull/Push/Set semantics (reference apps/simple.cc smoke +
+test_many_key_operations.cc phase 1)."""
+import numpy as np
+import pytest
+
+import adapm_tpu
+from adapm_tpu import LOCAL, Server, SystemOptions, make_mesh
+
+
+@pytest.fixture(scope="module")
+def ctx():
+    return make_mesh(8)
+
+
+def make_server(ctx, num_keys=64, vlen=4, **kw):
+    opts = kw.pop("opts", SystemOptions())
+    return Server(num_keys, vlen, opts=opts, ctx=ctx, **kw)
+
+
+def test_zero_init_pull(ctx):
+    s = make_server(ctx)
+    w = s.make_worker()
+    vals = w.pull_sync(np.arange(10))
+    assert vals.shape == (10, 4)
+    np.testing.assert_allclose(vals, 0.0)
+
+
+def test_push_then_pull_roundtrip(ctx):
+    s = make_server(ctx)
+    w = s.make_worker()
+    keys = np.array([1, 5, 9])
+    vals = np.arange(12, dtype=np.float32).reshape(3, 4)
+    ts = w.push(keys, vals)
+    w.wait(ts)
+    got = w.pull_sync(keys)
+    np.testing.assert_allclose(got, vals)
+
+
+def test_push_is_additive(ctx):
+    s = make_server(ctx)
+    w = s.make_worker()
+    keys = np.array([3])
+    v = np.ones((1, 4), np.float32)
+    for _ in range(5):
+        w.wait(w.push(keys, v))
+    np.testing.assert_allclose(w.pull_sync(keys), 5.0)
+
+
+def test_push_duplicate_keys_accumulate(ctx):
+    # same key twice in one batch: both increments must land
+    s = make_server(ctx)
+    w = s.make_worker()
+    keys = np.array([7, 7])
+    vals = np.ones((2, 4), np.float32)
+    w.wait(w.push(keys, vals))
+    np.testing.assert_allclose(w.pull_sync([7]), 2.0)
+
+
+def test_set_overwrites(ctx):
+    s = make_server(ctx)
+    w = s.make_worker()
+    keys = np.array([2])
+    w.wait(w.push(keys, np.full((1, 4), 5.0, np.float32)))
+    w.wait(w.set(keys, np.full((1, 4), 1.5, np.float32)))
+    np.testing.assert_allclose(w.pull_sync(keys), 1.5)
+    w.wait(w.push(keys, np.ones((1, 4), np.float32)))
+    np.testing.assert_allclose(w.pull_sync(keys), 2.5)
+
+
+def test_local_fast_path(ctx):
+    """Keys owned by the worker's shard answer locally with ts == -1
+    (reference coloc_kv_worker.h:120-186)."""
+    s = make_server(ctx)
+    w0 = s.make_worker(0)  # shard 0
+    own_keys = np.array([0, 8, 16])  # key % 8 == 0 -> shard 0
+    out = np.zeros(12, np.float32)
+    assert w0.pull(own_keys, out=out) == LOCAL
+    assert w0.push(own_keys, np.ones((3, 4), np.float32)) == LOCAL
+    remote_keys = np.array([1, 2])
+    ts = w0.pull(remote_keys)
+    assert ts != LOCAL
+    w0.wait(ts)
+
+
+def test_multi_worker_concurrent_pushes(ctx):
+    """All workers push to one contended key; total must be exact
+    (reference test_dynamic_allocation.cc:84-103)."""
+    s = make_server(ctx, num_workers=8)
+    ws = [s.make_worker(i) for i in range(8)]
+    key = np.array([13])
+    runs = 10
+    for _ in range(runs):
+        for w in ws:
+            w.push(key, np.full((1, 4), 1.0, np.float32))
+    for w in ws:
+        w.wait_all()
+    s.barrier()
+    expected = 8 * runs
+    for w in ws:
+        np.testing.assert_allclose(w.pull_sync(key), expected)
+
+
+def test_flat_value_buffers(ctx):
+    """Reference semantics: vals is a flat concat buffer of per-key lengths."""
+    s = make_server(ctx)
+    w = s.make_worker()
+    keys = np.array([4, 6])
+    flat = np.arange(8, dtype=np.float32)
+    w.wait(w.push(keys, flat))
+    out = np.zeros(8, np.float32)
+    ts = w.pull(keys, out=out)
+    w.wait(ts)
+    np.testing.assert_allclose(out, flat)
+
+
+def test_per_key_value_lengths(ctx):
+    """Mixed lengths (reference per-key value_lengths, kge.cc:1296-1306)."""
+    lens = np.array([2, 3, 2, 3, 1])
+    s = Server(5, lens, ctx=ctx)
+    w = s.make_worker()
+    keys = np.array([0, 1, 4])
+    flat = np.array([1, 1, 2, 2, 2, 3], dtype=np.float32)
+    w.wait(w.push(keys, flat))
+    got = w.pull(keys)
+    got = w.wait(got) if got != LOCAL else w._last_result
+    np.testing.assert_allclose(got, flat)
+
+
+def test_pull_if_local(ctx):
+    s = make_server(ctx)
+    w0 = s.make_worker(0)
+    ok, vals = w0.pull_if_local(np.array([0, 8]))
+    assert ok and vals.shape[0] == 8  # flat: 2 keys x len 4
+    ok, vals = w0.pull_if_local(np.array([1]))
+    assert not ok and vals is None
+
+
+def test_setup_helper():
+    s = adapm_tpu.setup(16, 2, num_shards=4)
+    w = s.make_worker()
+    w.wait(w.push([0], np.ones(2, np.float32)))
+    np.testing.assert_allclose(w.pull_sync([0]), 1.0)

@@ -236,16 +236,26 @@ class Server:
             todo = np.unique(keys[mask])
             if len(todo) == 0:
                 return []
+            created: List[int] = []
             for cid, pos in self._group_by_class(todo):
-                ks = todo[pos]
-                c_sl = np.array([ab.add_replica(int(k), shard) for k in ks],
-                                dtype=np.int32)
+                alloc = ab.cache_alloc[cid]
+                taken = []
+                for k in todo[pos]:
+                    if alloc.num_free(shard) == 0:
+                        break  # cache pool full: key stays remote
+                    ab.add_replica(int(k), shard)
+                    taken.append(int(k))
+                if not taken:
+                    continue
+                ks = np.asarray(taken, dtype=np.int64)
+                c_sl = ab.cache_slot[shard, ks].astype(np.int32)
                 o_sh = ab.owner[ks].astype(np.int32)
                 o_sl = ab.slot[ks].astype(np.int32)
                 c_sh = np.full_like(o_sh, shard)
                 self.stores[cid].replica_create(o_sh, o_sl, c_sh, c_sl)
+                created.extend(int(k) for k in ks)
             self.topology_version += 1
-            return [int(k) for k in todo]
+            return created
 
     def _sync_replicas(self, items: List[Tuple[int, int]]) -> None:
         with self._lock:
@@ -278,9 +288,12 @@ class Server:
             karr = np.array([k for k, _ in moves], dtype=np.int64)
             sarr = np.array([s for _, s in moves], dtype=np.int32)
             for cid, pos in self._group_by_class(karr):
-                old_sh, old_sl, new_sl, rc_sh, rc_sl = [], [], [], [], []
+                old_sh, old_sl, new_sh, new_sl, rc_sh, rc_sl = \
+                    [], [], [], [], [], []
                 for k, s in zip(karr[pos], sarr[pos]):
                     k, s = int(k), int(s)
+                    if ab.main_alloc[cid].num_free(s) == 0:
+                        continue  # destination pool full: skip this move
                     cs = int(ab.cache_slot[s, k])
                     if cs >= 0:
                         rc_sh.append(s); rc_sl.append(cs)
@@ -289,10 +302,13 @@ class Server:
                     else:
                         rc_sh.append(0); rc_sl.append(int(OOB))
                     osh, osl, nsl = ab.relocate(k, s)
-                    old_sh.append(osh); old_sl.append(osl); new_sl.append(nsl)
+                    old_sh.append(osh); old_sl.append(osl)
+                    new_sh.append(s); new_sl.append(nsl)
+                if not old_sh:
+                    continue
                 self.stores[cid].relocate_rows(
                     np.array(old_sh, np.int32), np.array(old_sl, np.int32),
-                    sarr[pos], np.array(new_sl, np.int32),
+                    np.array(new_sh, np.int32), np.array(new_sl, np.int32),
                     np.array(rc_sh, np.int32), np.array(rc_sl, np.int32))
             self.topology_version += 1
 

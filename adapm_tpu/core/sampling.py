@@ -217,22 +217,23 @@ class LocalSampling(SamplingBase):
             keys = self._snap(self._draw(n, worker), worker.shard)
         else:
             keys = np.empty(n, dtype=np.int64)
+            local = self._local_index(worker.shard)
             for i in range(n):
-                for _ in range(1000):
-                    k = int(self._snap(self._draw(1, worker),
-                                       worker.shard)[0])
-                    if k not in h.seen:
-                        break
-                    # collision: probe the next local key (WOR variant,
-                    # sampling.h:437-460)
-                    local = self._local_index(worker.shard)
+                k = int(self._snap(self._draw(1, worker), worker.shard)[0])
+                if k in h.seen:
+                    # collision: probe forward through the local index
+                    # (WOR variant, sampling.h:437-460)
                     j = int(np.searchsorted(local, k))
                     for step in range(1, len(local) + 1):
                         k2 = int(local[(j + step) % len(local)])
                         if k2 not in h.seen:
                             k = k2
                             break
-                    break
+                    else:
+                        # every locally-available key is used up: fall back
+                        # to a global WOR draw (key may be remote — slower,
+                        # never wrong)
+                        k = int(self._draw_wor(1, worker, set(h.seen))[0])
                 h.seen.add(k)
                 keys[i] = k
         vals = worker.pull_sync(keys)

@@ -81,23 +81,26 @@ class SyncManager:
         clocks = self.server.worker_clocks()
         self.timer.observe(clocks)
         window = self.timer.window()
-        relocations: List[Tuple[int, int]] = []   # (key, dest shard)
-        replications: Dict[int, List[int]] = defaultdict(list)  # shard->keys
         for w in self.server.workers():
             max_start = CLOCK_MAX if force else int(
                 clocks[w.worker_id] + window[w.worker_id])
             for keys, start, end in w._intent_queue.pop_relevant(max_start):
+                # actions are applied per intent entry: a later intent in the
+                # same drain must observe placement changes made by earlier
+                # ones, or locality decisions go stale
+                relocations: List[Tuple[int, int]] = []
+                replications: Dict[int, List[int]] = defaultdict(list)
                 self._register(w.shard, keys, end, relocations, replications)
                 self.stats.intents_processed += len(keys)
-        if relocations:
-            self.server._relocate(relocations)
-            self.stats.relocations += len(relocations)
-        for shard, keys in replications.items():
-            created = self.server._create_replicas(
-                np.asarray(keys, dtype=np.int64), shard)
-            for k in created:
-                self.replicas[self._chan(k)].add((k, shard))
-            self.stats.replicas_created += len(created)
+                if relocations:
+                    self.server._relocate(relocations)
+                    self.stats.relocations += len(relocations)
+                for shard, ks in replications.items():
+                    created = self.server._create_replicas(
+                        np.asarray(ks, dtype=np.int64), shard)
+                    for k in created:
+                        self.replicas[self._chan(k)].add((k, shard))
+                    self.stats.replicas_created += len(created)
 
     def _chan(self, key: int) -> int:
         return int(key_channel(np.asarray([key]), self.num_channels)[0])
@@ -112,6 +115,18 @@ class SyncManager:
         for k in keys[nonlocal_mask]:
             k = int(k)
             action = self._decide(k, shard)
+            cls = int(ab.key_class[k])
+            # graceful degradation under full pools (the reference's store is
+            # an unbounded hash map; ours is a fixed HBM pool): a relocation
+            # with no free main slot becomes a replication; a replication
+            # with no free cache slot is skipped (key stays remote — slower,
+            # never wrong)
+            if action == "relocate" and \
+                    ab.main_alloc[cls].num_free(shard) == 0:
+                action = "replicate"
+            if action == "replicate" and \
+                    ab.cache_alloc[cls].num_free(shard) == 0:
+                continue
             if action == "relocate":
                 relocations.append((k, shard))
             else:

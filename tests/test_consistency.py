@@ -1,0 +1,122 @@
+"""The main correctness suite, mirroring reference
+tests/test_many_key_operations.cc three phases (:93-345):
+  (1) pull+intent storm, (2) monotonic pushes (a pulled value may never be
+  below the known floor), (3) eventual consistency (push then revert,
+  quiesce, assert exact restore)."""
+import numpy as np
+import pytest
+
+from adapm_tpu import Server, SystemOptions, MgmtTechniques, make_mesh
+
+NK = 48
+VL = 2
+
+
+@pytest.fixture(scope="module")
+def ctx():
+    return make_mesh(4)
+
+
+@pytest.fixture(params=[MgmtTechniques.ALL, MgmtTechniques.REPLICATION_ONLY,
+                        MgmtTechniques.RELOCATION_ONLY])
+def server(ctx, request):
+    opts = SystemOptions(techniques=request.param, sync_max_per_sec=0)
+    s = Server(NK, VL, opts=opts, ctx=ctx, num_workers=4)
+    ws = [s.make_worker(i) for i in range(4)]
+    return s, ws
+
+
+def test_pull_intent_storm(server, rng):
+    """Phase 1: random pulls and intents interleaved with sync rounds never
+    produce wrong values (all zeros here since nothing is pushed)."""
+    s, ws = server
+    for it in range(15):
+        w = ws[it % 4]
+        keys = rng.choice(NK, size=rng.integers(1, 8), replace=False)
+        w.intent(keys, w.current_clock, w.current_clock + 3)
+        vals = w.pull_sync(keys)
+        np.testing.assert_allclose(vals, 0.0)
+        w.advance_clock()
+        if it % 3 == 0:
+            s.sync.run_round(all_channels=True)
+    s.quiesce()
+
+
+def test_monotonic_pushes(server, rng):
+    """Phase 2: workers push only positive increments to a tracked key; any
+    pull must see >= the per-worker known floor (own pushes are never lost)
+    and <= the global total (nothing is double-applied)."""
+    s, ws = server
+    key = np.array([17])
+    own_floor = np.zeros(4)
+    total = 0.0
+    for it in range(30):
+        wid = int(rng.integers(4))
+        w = ws[wid]
+        inc = float(rng.integers(1, 3))
+        w.push(key, np.full(VL, inc, np.float32))
+        own_floor[wid] += inc
+        total += inc
+        if rng.random() < 0.3:
+            w.intent(key, w.current_clock, w.current_clock + 2)
+        if rng.random() < 0.4:
+            s.sync.run_round(all_channels=True)
+        v = w.pull_sync(key)[0, 0]
+        assert v >= own_floor[wid] - 1e-4, (
+            f"read-your-writes violated: {v} < {own_floor[wid]}")
+        assert v <= total + 1e-4, f"over-applied: {v} > {total}"
+        if rng.random() < 0.2:
+            w.advance_clock()
+    s.quiesce()
+    for w in ws:
+        np.testing.assert_allclose(w.pull_sync(key)[0, 0], total, rtol=1e-6)
+
+
+def test_eventual_consistency_exact_restore(server, rng):
+    """Phase 3: push a delta then its negation from another worker; after
+    quiesce every worker reads the original value exactly
+    (test_many_key_operations.cc:375-385)."""
+    s, ws = server
+    keys = np.arange(NK)
+    base = rng.normal(size=(NK, VL)).astype(np.float32)
+    ws[0].wait(ws[0].set(keys, base))
+    s.quiesce()
+    # storm: random +d then -d pairs from random workers under intents
+    for it in range(20):
+        w = ws[int(rng.integers(4))]
+        k = rng.choice(NK, size=4, replace=False)
+        d = rng.normal(size=(4, VL)).astype(np.float32)
+        w.intent(k, w.current_clock, w.current_clock + 2)
+        w.push(k, d)
+        w2 = ws[int(rng.integers(4))]
+        w2.push(k, -d)
+        if it % 4 == 0:
+            s.sync.run_round(all_channels=True)
+        w.advance_clock()
+    for w in ws:
+        w.wait_all()
+    s.quiesce()
+    for w in ws:
+        got = w.pull_sync(keys)
+        np.testing.assert_allclose(got, base, atol=1e-4)
+
+
+def test_relocation_preserves_value(ctx):
+    """Stress the relocation path: bounce ownership of one key around while
+    pushing; the final total must be exact (test_dynamic_allocation.cc)."""
+    opts = SystemOptions(techniques=MgmtTechniques.RELOCATION_ONLY,
+                         sync_max_per_sec=0)
+    s = Server(NK, VL, opts=opts, ctx=ctx, num_workers=4)
+    ws = [s.make_worker(i) for i in range(4)]
+    key = np.array([5])
+    total = 0.0
+    for it in range(24):
+        w = ws[it % 4]
+        w.intent(key, w.current_clock, w.current_clock + 1)
+        s.sync.run_round(force_intents=True, all_channels=True)  # relocate now
+        w.push(key, np.ones(VL, np.float32))
+        total += 1.0
+        w.advance_clock()
+    s.quiesce()
+    for w in ws:
+        np.testing.assert_allclose(w.pull_sync(key), total)

@@ -1,0 +1,135 @@
+"""Locality semantics under intent: which shard holds which key, before and
+after Intent, after expiry — mirroring reference tests/test_locality_api.cc
+(:49-132, pinned to 3 servers there; we pin a 3-shard mesh here)."""
+import numpy as np
+import pytest
+
+from adapm_tpu import LOCAL, Server, SystemOptions, MgmtTechniques, make_mesh
+
+
+@pytest.fixture(scope="module")
+def ctx():
+    return make_mesh(3)
+
+
+def fresh(ctx, **kw):
+    opts = kw.pop("opts", SystemOptions())
+    s = Server(30, 2, opts=opts, ctx=ctx, num_workers=3, **kw)
+    ws = [s.make_worker(i) for i in range(3)]
+    return s, ws
+
+
+def test_initial_partition(ctx):
+    """Before any intent, key k lives on its home shard k % S
+    (reference addressbook.h:110-112)."""
+    s, ws = fresh(ctx)
+    for k in range(9):
+        assert s.ab.owner[k] == k % 3
+        assert s.ab.is_local(np.array([k]), k % 3).all()
+        assert not s.ab.is_local(np.array([k]), (k + 1) % 3).any()
+
+
+def test_local_op_returns_minus_one(ctx):
+    s, ws = fresh(ctx)
+    # worker 1 owns keys k % 3 == 1
+    assert ws[1].pull(np.array([1, 4, 7])) == LOCAL
+    assert ws[1].push(np.array([1]), np.ones(2, np.float32)) == LOCAL
+    assert ws[1].pull(np.array([0])) != LOCAL
+    ws[1].wait_all()
+
+
+def test_exclusive_intent_relocates(ctx):
+    """Single-shard interest => ownership moves (reference
+    sync_manager.h:624-644: relocate iff nobody else wants it)."""
+    s, ws = fresh(ctx)
+    ws[0].intent([4], 0, 10)  # home shard 1
+    ws[0].wait_sync()
+    assert s.ab.owner[4] == 0
+    assert len(s.ab.replica_shards(4)) == 0
+    # relocated key now answers locally
+    assert ws[0].pull(np.array([4])) == LOCAL
+
+
+def test_competing_intent_replicates(ctx):
+    s, ws = fresh(ctx)
+    ws[0].intent([5], 0, 100)
+    ws[0].wait_sync()
+    assert s.ab.owner[5] == 0          # relocated to 0 (exclusive)
+    ws[1].intent([5], 0, 100)
+    ws[1].wait_sync()
+    assert s.ab.owner[5] == 0          # stays: 0 still has interest
+    assert list(s.ab.replica_shards(5)) == [1]
+    # both shards answer locally now
+    assert ws[0].pull(np.array([5])) == LOCAL
+    assert ws[1].pull(np.array([5])) == LOCAL
+
+
+def test_replica_expiry(ctx):
+    """After workers' clocks pass the intent end, the replica is dropped
+    (reference handle.h:542-578 lazy intent GC)."""
+    s, ws = fresh(ctx)
+    ws[0].intent([8], 0, 3)            # home shard 2
+    ws[2].intent([8], 0, 3)            # competing interest
+    s.wait_sync()
+    # both interested shards are now local (one owns, one replicates —
+    # which is which depends on drain order, as in the reference)
+    assert s.ab.is_local(np.array([8]), 0).all()
+    assert s.ab.is_local(np.array([8]), 2).all()
+    assert s.ab.replica_count[8] == 1
+    for _ in range(5):
+        for w in ws:
+            w.advance_clock()
+    s.wait_sync()
+    assert s.ab.replica_count[8] == 0
+    # pending replica deltas were flushed, not lost
+    # (drop goes through sync first)
+
+
+def test_replica_drop_flushes_delta(ctx):
+    s, ws = fresh(ctx)
+    ws[0].intent([8], 0, 3)
+    ws[2].intent([8], 0, 3)
+    s.wait_sync()
+    ws[0].push([8], np.full(2, 7.0, np.float32))  # lands in replica delta
+    ws[0].wait_all()
+    for _ in range(5):
+        for w in ws:
+            w.advance_clock()
+    s.wait_sync()  # drop + flush
+    np.testing.assert_allclose(ws[2].pull_sync([8]), 7.0)
+
+
+def test_techniques_replication_only(ctx):
+    opts = SystemOptions(techniques=MgmtTechniques.REPLICATION_ONLY)
+    s, ws = fresh(ctx, opts=opts)
+    ws[0].intent([4], 0, 10)
+    ws[0].wait_sync()
+    assert s.ab.owner[4] == 1           # home; never moved
+    assert list(s.ab.replica_shards(4)) == [0]
+
+
+def test_techniques_relocation_only(ctx):
+    opts = SystemOptions(techniques=MgmtTechniques.RELOCATION_ONLY)
+    s, ws = fresh(ctx, opts=opts)
+    ws[0].intent([5], 0, 100)
+    ws[0].wait_sync()
+    assert s.ab.owner[5] == 0
+    ws[1].intent([5], 0, 100)
+    ws[1].wait_sync()
+    # no replicas ever; ownership bounces to the latest requester
+    assert s.ab.owner[5] == 1
+    assert len(s.ab.replica_shards(5)) == 0
+
+
+def test_intent_for_future_clock_not_acted_early(ctx):
+    """Intents far in the future are registered but not acted on until the
+    clock window reaches them (ActionTimer, sync_manager.h:62-105)."""
+    s, ws = fresh(ctx)
+    ws[0].intent([7], 1000, 1010)      # home shard 1; far future
+    s.sync.run_round(all_channels=True)  # non-forced round
+    assert s.ab.owner[7] == 1          # untouched: start is beyond window
+    # once clocks approach, it acts
+    for _ in range(999):
+        ws[0].advance_clock()
+    s.sync.run_round(all_channels=True)
+    assert s.ab.is_local(np.array([7]), 0).all()

@@ -1,0 +1,93 @@
+"""Sampling correctness per scheme (reference tests/test_sampling.cc): value
+correctness of sampled pulls, WOR uniqueness, distribution sanity."""
+import numpy as np
+import pytest
+
+from adapm_tpu import Server, SystemOptions, make_mesh
+
+NK = 100
+
+
+@pytest.fixture(scope="module")
+def ctx():
+    return make_mesh(4)
+
+
+def make(ctx, scheme, with_replacement=True):
+    opts = SystemOptions(sampling_scheme=scheme,
+                         sampling_with_replacement=with_replacement,
+                         sync_max_per_sec=0)
+    s = Server(NK, 2, opts=opts, ctx=ctx, num_workers=4)
+    ws = [s.make_worker(i) for i in range(4)]
+    # values = key id so sampled pulls are checkable (reference
+    # test_sampling.cc: value correctness)
+    keys = np.arange(NK)
+    vals = np.repeat(keys.astype(np.float32)[:, None], 2, axis=1)
+    ws[0].wait(ws[0].set(keys, vals))
+    s.quiesce()
+    s.enable_sampling_support(
+        lambda n, rng: rng.integers(0, NK, size=n))
+    return s, ws
+
+
+@pytest.mark.parametrize("scheme", ["naive", "preloc", "pool", "local"])
+def test_sampled_values_correct(ctx, scheme):
+    s, ws = make(ctx, scheme)
+    w = ws[1]
+    h = w.prepare_sample(20)
+    if scheme == "preloc":
+        s.wait_sync()  # act on the intent the scheme signalled
+    keys, vals = w.pull_sample(h)
+    assert len(keys) == 20
+    np.testing.assert_allclose(vals[:, 0], keys.astype(np.float32))
+    w.finish_sample(h)
+
+
+@pytest.mark.parametrize("scheme", ["naive", "preloc", "pool", "local"])
+def test_without_replacement_unique(ctx, scheme):
+    s, ws = make(ctx, scheme, with_replacement=False)
+    w = ws[2]
+    h = w.prepare_sample(30)
+    if scheme == "preloc":
+        s.wait_sync()
+    keys, _ = w.pull_sample(h)
+    assert len(np.unique(keys)) == len(keys), "WOR produced duplicates"
+
+
+def test_partial_pulls(ctx):
+    """PullSample may be called repeatedly for portions of the prepared
+    budget (reference PullSample(handle, keys, vals) with n < N)."""
+    s, ws = make(ctx, "naive")
+    w = ws[0]
+    h = w.prepare_sample(10)
+    k1, _ = w.pull_sample(h, 4)
+    k2, _ = w.pull_sample(h, 6)
+    assert len(k1) == 4 and len(k2) == 6
+    with pytest.raises(AssertionError):
+        w.pull_sample(h, 1)  # over budget
+
+
+def test_local_scheme_stays_local(ctx):
+    """The local scheme must never leave the worker's shard (that is its
+    contract; distribution distortion is the documented trade-off,
+    sampling.h:361-365)."""
+    s, ws = make(ctx, "local")
+    w = ws[3]
+    before = dict(w.stats)
+    h = w.prepare_sample(50)
+    keys, _ = w.pull_sample(h)
+    local = s.ab.is_local(keys, w.shard)
+    assert local.all(), "local scheme sampled a non-local key"
+    assert w.stats["pull_params_local"] - before["pull_params_local"] == 50
+
+
+def test_distribution_sanity(ctx):
+    """Sampled frequencies should roughly follow the app distribution for
+    the exact schemes (naive/preloc/pool with reuse=1)."""
+    s, ws = make(ctx, "naive")
+    w = ws[0]
+    h = w.prepare_sample(4000)
+    keys, _ = w.pull_sample(h)
+    counts = np.bincount(keys, minlength=NK)
+    # uniform distribution: each key ~40 hits; allow generous slack
+    assert counts.min() > 5 and counts.max() < 120

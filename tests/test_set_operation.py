@@ -1,0 +1,58 @@
+"""Set-vs-Push interleavings under intent chaos (reference
+tests/test_set_operation.cc)."""
+import numpy as np
+import pytest
+
+from adapm_tpu import Server, SystemOptions, make_mesh
+
+
+@pytest.fixture(scope="module")
+def ctx():
+    return make_mesh(4)
+
+
+def test_set_then_push_orders(ctx):
+    s = Server(16, 2, ctx=ctx, num_workers=4)
+    ws = [s.make_worker(i) for i in range(4)]
+    k = np.array([6])
+    ws[0].wait(ws[0].push(k, np.full(2, 10.0, np.float32)))
+    ws[1].wait(ws[1].set(k, np.full(2, 3.0, np.float32)))
+    ws[2].wait(ws[2].push(k, np.full(2, 2.0, np.float32)))
+    s.quiesce()
+    for w in ws:
+        np.testing.assert_allclose(w.pull_sync(k), 5.0)
+
+
+def test_set_visible_through_replicas(ctx):
+    """A Set must be observed by replica holders after sync (their stale
+    base is refreshed)."""
+    s = Server(16, 2, ctx=ctx, num_workers=4,
+               opts=SystemOptions(sync_max_per_sec=0))
+    ws = [s.make_worker(i) for i in range(4)]
+    k = np.array([9])  # home shard 1
+    ws[0].intent(k, 0, 100)
+    ws[1].intent(k, 0, 100)
+    s.wait_sync()
+    assert s.ab.has_replica(k, 0).all() or s.ab.owner[9] == 0
+    ws[1].wait(ws[1].set(k, np.full(2, 42.0, np.float32)))
+    s.quiesce()
+    np.testing.assert_allclose(ws[0].pull_sync(k), 42.0)
+    np.testing.assert_allclose(ws[1].pull_sync(k), 42.0)
+
+
+def test_set_on_replica_holder_clears_pending_delta(ctx):
+    """If a worker holds a replica with pending delta and then Sets the key,
+    its pending delta must not resurface later."""
+    s = Server(16, 2, ctx=ctx, num_workers=4,
+               opts=SystemOptions(sync_max_per_sec=0))
+    ws = [s.make_worker(i) for i in range(4)]
+    k = np.array([9])
+    ws[0].intent(k, 0, 100)
+    ws[1].intent(k, 0, 100)
+    s.wait_sync()
+    ws[0].push(k, np.full(2, 5.0, np.float32))   # pending in replica delta
+    ws[0].wait_all()
+    ws[0].wait(ws[0].set(k, np.full(2, 1.0, np.float32)))
+    s.quiesce()
+    for w in ws:
+        np.testing.assert_allclose(w.pull_sync(k), 1.0)

@@ -154,6 +154,22 @@ class Server:
 
     # -- routing helpers (host) ---------------------------------------------
 
+    def _route(self, keys: np.ndarray, shard: int):
+        """Resolve keys (any shape) to pool coordinates for a worker on
+        `shard`, preferring a local replica over the owner row (the single
+        routing policy shared by Pull/Push and the fused step, ops/fused.py).
+        Returns (o_sh, o_sl, c_sh, c_sl, use_c, n_remote): owner shard+slot,
+        replica shard+slot (OOB where none), replica mask, remote-key count."""
+        ab = self.ab
+        o_sh = ab.owner[keys].astype(np.int32)
+        o_sl = ab.slot[keys].astype(np.int32)
+        cs = ab.cache_slot[shard, keys].astype(np.int32)
+        use_c = cs >= 0
+        n_remote = int((~(use_c | (o_sh == shard))).sum())
+        c_sh = np.full_like(o_sh, shard)
+        c_sl = np.where(use_c, cs, OOB).astype(np.int32)
+        return o_sh, o_sl, c_sh, c_sl, use_c, n_remote
+
     def _group_by_class(self, keys: np.ndarray):
         """Split a key batch by length class; returns [(cid, positions)]."""
         kc = self.ab.key_class[keys]
@@ -179,18 +195,12 @@ class Server:
 
     def _pull(self, keys: np.ndarray, shard: int):
         """Returns (groups, n_remote): one gather per length class."""
-        ab = self.ab
         groups = []
         n_remote = 0
         for cid, pos in self._group_by_class(keys):
             ks = keys[pos]
-            o_sh = ab.owner[ks].astype(np.int32)
-            o_sl = ab.slot[ks].astype(np.int32)
-            cs = ab.cache_slot[shard, ks].astype(np.int32)
-            use_c = cs >= 0
-            n_remote += int((~(use_c | (o_sh == shard))).sum())
-            c_sh = np.full_like(o_sh, shard)
-            c_sl = np.where(use_c, cs, OOB).astype(np.int32)
+            o_sh, o_sl, c_sh, c_sl, use_c, nr = self._route(ks, shard)
+            n_remote += nr
             o_sl = np.where(use_c, OOB, o_sl).astype(np.int32)
             vals = self.stores[cid].gather(o_sh, o_sl, c_sh, c_sl, use_c)
             groups.append((cid, pos, self.value_lengths[ks], vals, len(ks)))
@@ -198,7 +208,6 @@ class Server:
 
     def _push(self, keys: np.ndarray, vals: np.ndarray, shard: int,
               is_set: bool = False) -> int:
-        ab = self.ab
         flat = vals.ndim == 1
         n_remote = 0
         for cid, pos in self._group_by_class(keys):
@@ -208,22 +217,16 @@ class Server:
                 rows = self._flat_parts(keys, vals, pos, L)
             else:
                 rows = vals[pos]
-            o_sh = ab.owner[ks].astype(np.int32)
-            o_sl = ab.slot[ks].astype(np.int32)
-            cs = ab.cache_slot[shard, ks].astype(np.int32)
-            use_c = cs >= 0
-            c_sh = np.full_like(o_sh, shard)
+            o_sh, o_sl, c_sh, c_sl, use_c, nr = self._route(ks, shard)
             if is_set:
                 # Set writes through to the main copy and refreshes the
                 # writer's local replica (store._set_rows docstring)
                 n_remote += int((o_sh != shard).sum())
-                c_sl = np.where(use_c, cs, OOB).astype(np.int32)
                 self.stores[cid].set_rows(o_sh, o_sl, rows, c_sh, c_sl)
             else:
-                n_remote += int((~(use_c | (o_sh == shard))).sum())
-                d_sl = np.where(use_c, cs, OOB).astype(np.int32)
+                n_remote += nr
                 o_sl = np.where(use_c, OOB, o_sl).astype(np.int32)
-                self.stores[cid].scatter_add(o_sh, o_sl, c_sh, d_sl, rows)
+                self.stores[cid].scatter_add(o_sh, o_sl, c_sh, c_sl, rows)
         return n_remote
 
     # -- planner ops (called by SyncManager) ---------------------------------
@@ -254,7 +257,8 @@ class Server:
                 c_sh = np.full_like(o_sh, shard)
                 self.stores[cid].replica_create(o_sh, o_sl, c_sh, c_sl)
                 created.extend(int(k) for k in ks)
-            self.topology_version += 1
+            if created:
+                self.topology_version += 1
             return created
 
     def _sync_replicas(self, items: List[Tuple[int, int]]) -> None:
@@ -278,13 +282,19 @@ class Server:
                 self.ab.drop_replica(int(k), int(s))
             self.topology_version += 1
 
-    def _relocate(self, moves: List[Tuple[int, int]]) -> None:
+    def _relocate(self, moves: List[Tuple[int, int]]) -> int:
+        """Move main copies. Returns the number of moves actually performed;
+        a move whose destination main pool is full is demoted to a
+        replication attempt (the planner's graceful-degradation policy,
+        sync.py _register) rather than silently dropped."""
         with self._lock:
             ab = self.ab
             moves = [(int(k), int(s)) for k, s in moves
                      if int(s) != int(ab.owner[int(k)])]
             if not moves:
-                return
+                return 0
+            moved = 0
+            demoted: Dict[int, List[int]] = {}
             karr = np.array([k for k, _ in moves], dtype=np.int64)
             sarr = np.array([s for _, s in moves], dtype=np.int32)
             for cid, pos in self._group_by_class(karr):
@@ -293,7 +303,8 @@ class Server:
                 for k, s in zip(karr[pos], sarr[pos]):
                     k, s = int(k), int(s)
                     if ab.main_alloc[cid].num_free(s) == 0:
-                        continue  # destination pool full: skip this move
+                        demoted.setdefault(s, []).append(k)
+                        continue
                     cs = int(ab.cache_slot[s, k])
                     if cs >= 0:
                         rc_sh.append(s); rc_sl.append(cs)
@@ -310,7 +321,14 @@ class Server:
                     np.array(old_sh, np.int32), np.array(old_sl, np.int32),
                     np.array(new_sh, np.int32), np.array(new_sl, np.int32),
                     np.array(rc_sh, np.int32), np.array(rc_sl, np.int32))
-            self.topology_version += 1
+                moved += len(old_sh)
+            if moved:
+                self.topology_version += 1
+        for s, ks in demoted.items():
+            created = self._create_replicas(np.asarray(ks, np.int64), s)
+            for k in created:
+                self.sync.replicas[self.sync._chan(k)].add((k, s))
+        return moved
 
     # -- lifecycle -----------------------------------------------------------
 

@@ -93,8 +93,8 @@ class SyncManager:
                 self._register(w.shard, keys, end, relocations, replications)
                 self.stats.intents_processed += len(keys)
                 if relocations:
-                    self.server._relocate(relocations)
-                    self.stats.relocations += len(relocations)
+                    self.stats.relocations += self.server._relocate(
+                        relocations)
                 for shard, ks in replications.items():
                     created = self.server._create_replicas(
                         np.asarray(ks, dtype=np.int64), shard)

@@ -1,0 +1,6 @@
+"""Model families ported from the reference apps (SURVEY.md §2.3), as pure
+JAX scoring/loss functions pluggable into ops.fused."""
+from .kge import (complex_eval_scores, complex_score, make_kge_loss,  # noqa
+                  rescal_score)
+from .mf import col_key, full_loss, make_mf_loss, row_key  # noqa
+from .sgns import build_unigram_table, sgns_loss, syn0_key, syn1_key  # noqa

@@ -1,0 +1,85 @@
+"""Knowledge-graph embedding models: ComplEx and RESCAL.
+
+Reference apps/knowledge_graph_embeddings.cc (ComplEx score/grad :832-858,
+RESCAL :860-907, AdaGrad :415-435, negative sampling via PullSample
+:452-465). Here the scoring functions are pure JAX on *batches* of triples,
+so score + grad + update fuse into one XLA program (ops/fused.py) instead of
+the reference's per-triple loop.
+
+Embedding layout: an entity row holds a complex vector of dimension `dim` as
+[re | im] (2*dim floats); ComplEx relations are the same; RESCAL relations
+are a real dim x dim matrix (dim^2 floats). The stored value row additionally
+carries the AdaGrad accumulator (ops/fused.py layout [emb | acc]).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def complex_score(s: jnp.ndarray, r: jnp.ndarray,
+                  o: jnp.ndarray) -> jnp.ndarray:
+    """Re(<s, r, conj(o)>) for [..., 2d] embeddings (kge.cc ComplEx)."""
+    d = s.shape[-1] // 2
+    sr, si = s[..., :d], s[..., d:]
+    rr, ri = r[..., :d], r[..., d:]
+    orr, oi = o[..., :d], o[..., d:]
+    return (sr * rr * orr + si * rr * oi
+            + sr * ri * oi - si * ri * orr).sum(-1)
+
+
+def rescal_score(s: jnp.ndarray, r: jnp.ndarray,
+                 o: jnp.ndarray) -> jnp.ndarray:
+    """s^T R o with R = r reshaped to [d, d] (kge.cc RESCAL)."""
+    d = s.shape[-1]
+    R = r.reshape(r.shape[:-1] + (d, d))
+    return jnp.einsum("...i,...ij,...j->...", s, R, o)
+
+
+def _nll_loss(pos: jnp.ndarray, neg_s: jnp.ndarray,
+              neg_o: jnp.ndarray) -> jnp.ndarray:
+    """Negative-sampling logistic loss: -log sig(pos) - sum log sig(-neg)
+    (the reference trains with sigmoid loss over neg_ratio negatives per
+    side, kge.cc train loop :437-531)."""
+    pos_l = jax.nn.softplus(-pos)
+    neg_l = jax.nn.softplus(neg_s).sum(-1) + jax.nn.softplus(neg_o).sum(-1)
+    return (pos_l + neg_l).mean()
+
+
+def make_kge_loss(model: str = "complex"):
+    """loss_fn for ops/fused.py. Roles: s, r, o [B, *]; neg [B, N] entity
+    embeddings used to corrupt both the subject and the object side."""
+    score = {"complex": complex_score, "rescal": rescal_score}[model]
+
+    def loss_fn(embs, aux):
+        s, r, o, neg = embs["s"], embs["r"], embs["o"], embs["neg"]
+        pos = score(s, r, o)
+        # corrupt subject and object with the same negative pool
+        neg_s = score(neg, r[:, None, :], o[:, None, :])
+        neg_o = score(s[:, None, :], r[:, None, :], neg)
+        return _nll_loss(pos, neg_s, neg_o)
+
+    return loss_fn
+
+
+def complex_eval_scores(ent: jnp.ndarray, rel: jnp.ndarray,
+                        s: jnp.ndarray, r: jnp.ndarray,
+                        o: jnp.ndarray) -> jnp.ndarray:
+    """All-entity scores for filtered-MRR eval (kge.cc Evaluator :544-775):
+    given full entity matrix [E, 2d] and a triple batch, return
+    (scores_o [B, E] for object prediction, scores_s [B, E] for subject).
+    One matmul per side -> MXU-friendly."""
+    d = ent.shape[-1] // 2
+    er, ei = ent[..., :d], ent[..., d:]
+    sr, si = s[..., :d], s[..., d:]
+    rr, ri = r[..., :d], r[..., d:]
+    # object prediction: Re(<s, r, conj(e)>) for all e
+    a = sr * rr - si * ri   # coefficient of e_re
+    b = sr * ri + si * rr   # coefficient of e_im
+    scores_o = a @ er.T + b @ ei.T
+    # subject prediction: Re(<e, r, conj(o)>) for all e
+    orr, oi = o[..., :d], o[..., d:]
+    c = rr * orr + ri * oi
+    dcoef = rr * oi - ri * orr
+    scores_s = c @ er.T + dcoef @ ei.T
+    return scores_o, scores_s

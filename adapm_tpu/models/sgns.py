@@ -1,0 +1,56 @@
+"""Skip-gram negative-sampling word2vec (SGNS).
+
+Reference apps/word2vec.cc (Google-C w2v ported to the PM): two keys per
+word — syn0 (input embedding) = 2w, syn1 (output embedding) = 2w+1
+(word2vec.cc:83-105); unigram^0.75 negative table (:125-144); AdaGrad
+update (:718-743). Here one fused step trains a whole batch of (center,
+context) pairs with N shared-per-pair negatives.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def syn0_key(word: np.ndarray):
+    """Input-embedding key for word id(s) (word2vec.cc:83-105)."""
+    return 2 * np.asarray(word, dtype=np.int64)
+
+
+def syn1_key(word: np.ndarray):
+    """Output-embedding key for word id(s)."""
+    return 2 * np.asarray(word, dtype=np.int64) + 1
+
+
+def sgns_loss(embs, aux):
+    """Roles: center [B, d] (syn0), ctx [B, d] (syn1), neg [B, N, d] (syn1).
+    loss = -log sig(u.v) - sum log sig(-u.v_neg)."""
+    center, ctx, neg = embs["center"], embs["ctx"], embs["neg"]
+    pos = (center * ctx).sum(-1)
+    negs = (center[:, None, :] * neg).sum(-1)
+    return (jax.nn.softplus(-pos) + jax.nn.softplus(negs).sum(-1)).mean()
+
+
+def build_unigram_table(counts: np.ndarray, power: float = 0.75):
+    """Noise distribution over words: count^0.75 / Z (word2vec.cc:125-144).
+    Returns a sampler closure `fn(n, rng) -> word ids` suitable for
+    Server.enable_sampling_support (drawing *syn1 keys* is the caller's
+    concern via syn1_key)."""
+    p = counts.astype(np.float64) ** power
+    p /= p.sum()
+
+    def sample(n: int, rng: np.random.Generator) -> np.ndarray:
+        return rng.choice(len(p), size=n, p=p).astype(np.int64)
+
+    return sample
+
+
+def subsample_mask(word_counts: np.ndarray, words: np.ndarray,
+                   total: int, t: float, rng) -> np.ndarray:
+    """Frequent-word subsampling keep-mask (word2vec.cc uses the classic
+    1 - sqrt(t/f) discard rule)."""
+    f = word_counts[words] / max(total, 1)
+    keep_p = np.minimum(1.0, np.sqrt(t / np.maximum(f, 1e-12))
+                        + t / np.maximum(f, 1e-12))
+    return rng.random(len(words)) < keep_p

@@ -1,0 +1,3 @@
+"""Hot-path device programs: fused gather->grad->AdaGrad->scatter steps."""
+from .fused import (FusedStepRunner, Routes, build_routes,  # noqa
+                    make_fused_adagrad_step)

@@ -1,0 +1,184 @@
+"""Fused embedding-update steps: the TPU-native hot path.
+
+Every reference app's inner loop is the same triad: Pull a handful of rows,
+run a small dense compute + AdaGrad, Push additive updates (mf/update.h:32-70,
+word2vec.cc:718-743, kge.cc:415-530). Translating that per-key loop would
+leave the MXU idle; instead the whole triad over a *batch* of data points is
+ONE jitted program on the sharded pools:
+
+    gather rows -> model loss -> grad -> AdaGrad transform -> scatter-add
+
+Updates remain *additive deltas*, so the parameter-manager semantics
+(concurrent pushes merge at the main copy; replica writes land in the delta
+pool and flow back through sync rounds) are preserved exactly — the fused
+step is a batched `Push` in PM terms, not a bypass.
+
+Value-row layout follows the reference convention of carrying optimizer
+state inside the value (`param_len = 2*rank = [factor | adagrad]`,
+matrix_factorization.cc:695-697): row = [emb (D) | adagrad acc (D)].
+
+Routing (which shard/slot serves each key) is resolved on the host from the
+Addressbook — exactly what `Server._pull`/`_push` do — and handed to the
+program as index arrays, so relocation/replication decisions made by the
+planner between steps are transparently picked up.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Dict, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.store import OOB
+
+
+class Routes:
+    """Device index arrays routing one role's key batch to pool rows.
+
+    gather:  main[g_sh, g_sl] for owner-served keys, (cache+delta)[c_sh, c_sl]
+             for replica-served keys (use_c mask).
+    scatter: derived inside jit — owner path drops replica positions (OOB),
+             delta path drops owner positions (mirrors Server._push).
+    """
+
+    __slots__ = ("g_sh", "g_sl", "c_sh", "c_sl", "use_c", "n_remote")
+
+    def __init__(self, g_sh, g_sl, c_sh, c_sl, use_c, n_remote: int):
+        self.g_sh, self.g_sl = g_sh, g_sl
+        self.c_sh, self.c_sl = c_sh, c_sl
+        self.use_c = use_c
+        self.n_remote = n_remote
+
+    def as_tuple(self):
+        return (self.g_sh, self.g_sl, self.c_sh, self.c_sl, self.use_c)
+
+
+def build_routes(server, keys: np.ndarray, shard: int,
+                 expect_class: int = None) -> Routes:
+    """Resolve keys (any shape) to pool coordinates for a worker on `shard`,
+    via the one shared routing policy (Server._route: prefer a local replica,
+    else the owner row). All keys must share a length class; pass
+    `expect_class` to fail fast on a wrong role->class mapping (slots are
+    per-class row indices, so a mismatch would corrupt another pool's rows).
+    """
+    keys = np.asarray(keys, dtype=np.int64)
+    if expect_class is not None:
+        kc = server.ab.key_class[keys]
+        assert (kc == expect_class).all(), (
+            f"keys span length classes {np.unique(kc)} but role is mapped "
+            f"to class {expect_class}")
+    o_sh, o_sl, c_sh, c_sl, use_c, n_remote = server._route(keys, shard)
+    g_sl = np.where(use_c, OOB, o_sl).astype(np.int32)
+    return Routes(jnp.asarray(o_sh), jnp.asarray(g_sl), jnp.asarray(c_sh),
+                  jnp.asarray(c_sl), jnp.asarray(use_c), n_remote)
+
+
+def _read_rows(main, cache, delta, route):
+    g_sh, g_sl, c_sh, c_sl, use_c = route
+    m = main.at[g_sh, g_sl].get(mode="fill", fill_value=0)
+    c = (cache.at[c_sh, c_sl].get(mode="fill", fill_value=0)
+         + delta.at[c_sh, c_sl].get(mode="fill", fill_value=0))
+    return jnp.where(use_c[..., None], c, m)
+
+
+def _scatter_update(main, delta, route, upd):
+    g_sh, g_sl, c_sh, c_sl, use_c = route
+    # owner path: g_sl already carries OOB at replica positions
+    main = main.at[g_sh, g_sl].add(upd, mode="drop")
+    # replica path: c_sl already carries OOB at owner positions
+    delta = delta.at[c_sh, c_sl].add(upd, mode="drop")
+    return main, delta
+
+
+def make_fused_adagrad_step(
+        loss_fn: Callable[..., jnp.ndarray],
+        role_class: Dict[str, int],
+        role_dim: Dict[str, int],
+        frozen_roles: Sequence[str] = ()):
+    """Build the jitted fused step.
+
+    loss_fn(embs: dict role -> [..., D_role] array, aux) -> scalar mean loss.
+    role_class: role -> length-class id (index into the pools argument).
+    role_dim:   role -> embedding dim D (row length must be 2*D: [emb|acc]).
+    frozen_roles: gathered for the forward pass but never updated.
+
+    Returns step(pools, routes, aux, lr, eps) -> (pools, loss) where
+      pools  = tuple over classes of (main, cache, delta)   [donated]
+      routes = dict role -> Routes.as_tuple()
+      aux    = arbitrary pytree handed to loss_fn (labels, weights, rng keys)
+    """
+    roles = sorted(role_class)
+    trainable = [r for r in roles if r not in frozen_roles]
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def step(pools, routes, aux, lr, eps):
+        rows = {}
+        for r in roles:
+            main, cache, delta = pools[role_class[r]]
+            rows[r] = _read_rows(main, cache, delta, routes[r])
+        embs = {r: rows[r][..., : role_dim[r]] for r in roles}
+        accs = {r: rows[r][..., role_dim[r]:] for r in roles}
+
+        def objective(train_embs):
+            merged = dict(embs)
+            merged.update(train_embs)
+            return loss_fn(merged, aux)
+
+        loss, grads = jax.value_and_grad(objective)(
+            {r: embs[r] for r in trainable})
+
+        new_pools = list(pools)
+        for r in trainable:
+            g = grads[r]
+            g2 = g * g
+            # AdaGrad with the accumulator carried in the value row
+            # (reference UpdateNsqlL2Adagrad, apps/mf/update.h:23-79)
+            upd_emb = -lr * g * jax.lax.rsqrt(accs[r] + g2 + eps)
+            upd = jnp.concatenate([upd_emb, g2], axis=-1)
+            cid = role_class[r]
+            main, cache, delta = new_pools[cid]
+            main, delta = _scatter_update(main, delta, routes[r], upd)
+            new_pools[cid] = (main, cache, delta)
+        return tuple(new_pools), loss
+
+    return step
+
+
+class FusedStepRunner:
+    """Binds a fused step to a Server: swaps pools in/out of the ShardedStores
+    so the PM view (Pull/Push/sync rounds) and the fused hot loop always see
+    the same buffers."""
+
+    def __init__(self, server, loss_fn, role_class: Dict[str, int],
+                 role_dim: Dict[str, int], frozen_roles: Sequence[str] = ()):
+        self.server = server
+        self.role_class = role_class
+        self.step_fn = make_fused_adagrad_step(
+            loss_fn, role_class, role_dim, frozen_roles)
+        self.n_remote = 0
+        self.steps = 0
+
+    def routes_for(self, role_keys: Dict[str, np.ndarray],
+                   shard: int) -> Dict[str, tuple]:
+        out = {}
+        for r, keys in role_keys.items():
+            rt = build_routes(self.server, keys, shard,
+                              expect_class=self.role_class[r])
+            self.n_remote += rt.n_remote
+            out[r] = rt.as_tuple()
+        return out
+
+    def __call__(self, role_keys: Dict[str, np.ndarray], aux, lr: float,
+                 eps: float = 1e-10, shard: int = 0) -> jnp.ndarray:
+        srv = self.server
+        with srv._lock:
+            routes = self.routes_for(role_keys, shard)
+            pools = tuple((s.main, s.cache, s.delta) for s in srv.stores)
+            pools, loss = self.step_fn(
+                pools, routes, aux, jnp.float32(lr), jnp.float32(eps))
+            for st, (m, c, d) in zip(srv.stores, pools):
+                st.main, st.cache, st.delta = m, c, d
+        self.steps += 1
+        return loss

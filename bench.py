@@ -1,0 +1,133 @@
+"""Headline benchmark: fused KGE ComplEx training throughput (triples/sec).
+
+The reference's headline workload is ComplEx KGE training (README.md:140-159;
+BASELINE.json north star: beat AdaPM-CPU 8-node wall-clock). This bench runs
+the framework's fused train step (gather -> ComplEx score/grad -> AdaGrad ->
+scatter-add on the sharded HBM pools, ops/fused.py) on the available device
+and reports triples/sec.
+
+vs_baseline: the reference publishes no in-tree numbers (BASELINE.md), so the
+baseline is measured here as a proxy: the same per-triple ComplEx+AdaGrad
+update in numpy (the reference's CPU compute pattern, kge.cc:415-530, one
+triple at a time), scaled x64 for the paper's 8 nodes x 8 worker threads.
+vs_baseline = tpu_triples_per_sec / (64 * cpu_single_thread_triples_per_sec).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def bench_tpu(E=200_000, R=1_000, d=128, B=4096, N=32, steps=20,
+              warmup=3) -> float:
+    import jax
+
+    import adapm_tpu
+    from adapm_tpu.config import SystemOptions
+    from adapm_tpu.models import make_kge_loss
+    from adapm_tpu.ops import FusedStepRunner
+
+    num_keys = E + R
+    srv = adapm_tpu.setup(num_keys, 4 * d,
+                          opts=SystemOptions(cache_slots_per_shard=1))
+    w = srv.make_worker(0)
+    rng = np.random.default_rng(0)
+    # initialize in slabs to bound host memory
+    slab = 50_000
+    for lo in range(0, num_keys, slab):
+        hi = min(lo + slab, num_keys)
+        vals = rng.normal(size=(hi - lo, 4 * d)).astype(np.float32) * 0.1
+        vals[:, 2 * d:] = 1e-6
+        w.set(np.arange(lo, hi), vals)
+    srv.block()
+
+    runner = FusedStepRunner(
+        srv, make_kge_loss("complex"),
+        role_class={"s": 0, "r": 0, "o": 0, "neg": 0},
+        role_dim={k: 2 * d for k in ("s", "r", "o", "neg")})
+
+    def batch():
+        return {
+            "s": rng.integers(0, E, B).astype(np.int64),
+            "r": rng.integers(E, E + R, B).astype(np.int64),
+            "o": rng.integers(0, E, B).astype(np.int64),
+            "neg": rng.integers(0, E, (B, N)).astype(np.int64),
+        }
+
+    for _ in range(warmup):
+        runner(batch(), None, 0.1)
+    srv.block()
+
+    t0 = time.perf_counter()
+    loss = 0.0
+    for _ in range(steps):
+        loss = runner(batch(), None, 0.1)
+    jax.block_until_ready(loss)
+    srv.block()
+    dt = time.perf_counter() - t0
+    srv.shutdown()
+    return B * steps / dt
+
+
+def bench_cpu_reference_proxy(E=20_000, R=100, d=128, N=32,
+                              triples=300) -> float:
+    """Single-thread numpy per-triple ComplEx + AdaGrad (the reference's
+    per-data-point CPU hot loop shape, kge.cc train :437-531)."""
+    rng = np.random.default_rng(0)
+    ent = rng.normal(size=(E, 2 * d)).astype(np.float32) * 0.1
+    rel = rng.normal(size=(R, 2 * d)).astype(np.float32) * 0.1
+    ent_a = np.full((E, 2 * d), 1e-6, dtype=np.float32)
+    rel_a = np.full((R, 2 * d), 1e-6, dtype=np.float32)
+    lr, eps = 0.1, 1e-10
+
+    def score_grad(s, r, o):
+        sr, si = s[:d], s[d:]
+        rr, ri = r[:d], r[d:]
+        orr, oi = o[:d], o[d:]
+        sc = float((sr * rr * orr + si * rr * oi
+                    + sr * ri * oi - si * ri * orr).sum())
+        gs = np.concatenate([rr * orr + ri * oi, rr * oi - ri * orr])
+        gr = np.concatenate([sr * orr + si * oi, sr * oi - si * orr])
+        go = np.concatenate([sr * rr + si * ri, si * rr - sr * ri])
+        return sc, gs, gr, go
+
+    def adagrad(table, acc, idx, g):
+        acc[idx] += g * g
+        table[idx] -= lr * g / np.sqrt(acc[idx] + eps)
+
+    t0 = time.perf_counter()
+    for _ in range(triples):
+        s, o = rng.integers(0, E, 2)
+        r = rng.integers(0, R)
+        sc, gs, gr, go = score_grad(ent[s], rel[r], ent[o])
+        w = 1.0 / (1.0 + np.exp(sc)) if sc < 30 else 0.0  # sigmoid'(pos)
+        adagrad(ent, ent_a, s, -w * gs)
+        adagrad(rel, rel_a, r, -w * gr)
+        adagrad(ent, ent_a, o, -w * go)
+        for n in rng.integers(0, E, 2 * N):  # corrupt both sides
+            sc, gs, gr, go = score_grad(ent[n], rel[r], ent[o])
+            w = 1.0 / (1.0 + np.exp(-sc)) if sc > -30 else 0.0
+            adagrad(ent, ent_a, n, w * gs)
+            adagrad(rel, rel_a, r, w * gr)
+            adagrad(ent, ent_a, o, w * go)
+    return triples / (time.perf_counter() - t0)
+
+
+def main():
+    tput = bench_tpu()
+    cpu = bench_cpu_reference_proxy()
+    baseline = 64.0 * cpu  # 8 nodes x 8 worker threads
+    print(json.dumps({
+        "metric": "kge_complex_train_throughput",
+        "value": round(tput, 1),
+        "unit": "triples/sec (d=128, B=4096, N=32 negs, E=200k)",
+        "vs_baseline": round(tput / baseline, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

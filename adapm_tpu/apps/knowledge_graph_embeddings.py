@@ -1,0 +1,329 @@
+"""Knowledge-graph embedding app: ComplEx & RESCAL with AdaGrad, filtered
+MRR/Hits@k eval, checkpoints (reference apps/knowledge_graph_embeddings.cc).
+
+Pipeline parity (kge.cc:1059-1122): for each future triple batch the worker
+signals `Intent({s, r, o})` and `PrepareSample(2*neg_ratio*B)` at the future
+clock; negatives arrive via PullSample (managed sampling). Clock advances per
+batch. Loss and eval statistics aggregate through PS keys — the reference's
+`ps_allreduce` / eval_key idiom (utils.h:163-197, kge.cc:544-775) — a loss
+key (length 1) and an eval key (length 8) live at the end of the key space.
+
+Key layout (kge.cc:1296-1306): entities [0, E) with embedding length 2*dim
+(ComplEx re|im) or dim (RESCAL); relations [E, E+R) length 2*dim (ComplEx) or
+dim^2 (RESCAL); stored rows carry AdaGrad inline: [emb | acc].
+
+Eval (kge.cc Evaluator :544-775): filtered MRR and Hits@{1,10}, ranking all
+entities for both subject and object replacement via full-entity matmuls
+(models/kge.py eval scores — MXU-shaped, unlike the reference's per-candidate
+loop).
+
+Run: python -m adapm_tpu.apps.knowledge_graph_embeddings --synthetic ...
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import Optional
+
+import numpy as np
+
+from ..io import kge as kgeio
+from ..models.kge import make_eval_scores, make_kge_loss
+from ..ops import FusedStepRunner
+from ..utils import Stopwatch, alog
+from .common import (KeyMapper, RuntimeGuard, add_common_arguments,
+                     enforce_full_replication, epoch_report, make_server,
+                     wrap_batches, worker0_init)
+
+EVAL_LEN = 8  # [mrr_sum, h1, h10, count, ...pad] (reference eval_key len 20)
+
+
+class KgeRun:
+    """Holds the server, key layout, and fused runner for one training run."""
+
+    def __init__(self, args, ds: kgeio.TripleDataset):
+        self.args = args
+        self.ds = ds
+        d = args.dim
+        E, R = ds.num_entities, ds.num_relations
+        self.ent_dim = 2 * d if args.model == "complex" else d
+        self.rel_dim = 2 * d if args.model == "complex" else d * d
+        self.E, self.R = E, R
+        self.loss_key_l = E + R          # logical loss key (kge.cc idiom)
+        self.eval_key_l = E + R + 1
+        num_keys = E + R + 2
+
+        value_lengths = np.empty(num_keys, dtype=np.int64)
+        value_lengths[:E] = 2 * self.ent_dim          # [emb | acc]
+        value_lengths[E:E + R] = 2 * self.rel_dim
+        value_lengths[self.loss_key_l] = 1
+        value_lengths[self.eval_key_l] = EVAL_LEN
+
+        # enforce_random_keys shuffles *within* each population: entities
+        # among [0, E), relations among [E, E+R). A joint shuffle would map
+        # entity keys onto relation-width rows (different value lengths);
+        # aux keys keep their identity.
+        self.ent_map = KeyMapper(E, args.enforce_random_keys, seed=args.seed)
+        self.rel_map = KeyMapper(R, args.enforce_random_keys,
+                                 seed=args.seed + 1)
+        self.srv = make_server(args, num_keys, value_lengths,
+                               num_workers=args.num_workers or None)
+        self.num_workers = args.num_workers or self.srv.num_shards
+        self.workers = [self.srv.make_worker(i)
+                        for i in range(self.num_workers)]
+
+        ab = self.srv.ab
+        self.ent_class = int(ab.key_class[0])
+        self.rel_class = int(ab.key_class[E])
+        self.runner = FusedStepRunner(
+            self.srv, make_kge_loss(args.model),
+            role_class={"s": self.ent_class, "r": self.rel_class,
+                        "o": self.ent_class, "neg": self.ent_class},
+            role_dim={"s": self.ent_dim, "r": self.rel_dim,
+                      "o": self.ent_dim, "neg": self.ent_dim})
+
+    # -- key helpers ---------------------------------------------------------
+
+    def ekey(self, e):   # entity logical -> physical
+        return self.ent_map(np.asarray(e, dtype=np.int64))
+
+    def rkey(self, r):   # relation logical -> physical
+        return self.rel_map(np.asarray(r, dtype=np.int64)) + self.E
+
+    # -- init / checkpoint ---------------------------------------------------
+
+    def init_model(self) -> None:
+        a = self.args
+        rng = np.random.default_rng(a.seed)
+        if a.init_from:
+            ck = np.load(a.init_from)
+            ent_rows = np.concatenate([ck["ent"], ck["ent_acc"]], axis=1)
+            rel_rows = np.concatenate([ck["rel"], ck["rel_acc"]], axis=1)
+            alog(f"[kge] initialized from checkpoint {a.init_from}")
+        else:
+            scale = a.init_scale
+            if a.init_scheme == "uniform":
+                ent = (rng.random((self.E, self.ent_dim)) - 0.5) * 2 * scale
+                rel = (rng.random((self.R, self.rel_dim)) - 0.5) * 2 * scale
+            else:  # normal (kge.cc init none/uniform/normal :988-1018)
+                ent = rng.normal(0, scale, (self.E, self.ent_dim))
+                rel = rng.normal(0, scale, (self.R, self.rel_dim))
+            ent_rows = np.concatenate(
+                [ent, np.full_like(ent, a.adagrad_init)], axis=1)
+            rel_rows = np.concatenate(
+                [rel, np.full_like(rel, a.adagrad_init)], axis=1)
+        worker0_init(self.workers, self.ekey(np.arange(self.E)),
+                     ent_rows.astype(np.float32))
+        w0 = self.workers[0]
+        w0.begin_setup()
+        w0.set(self.rkey(np.arange(self.R)), rel_rows.astype(np.float32))
+        w0.set(np.array([self.loss_key_l]), np.zeros(1, np.float32))
+        w0.set(np.array([self.eval_key_l]), np.zeros(EVAL_LEN, np.float32))
+        w0.end_setup()
+
+    def current_model(self):
+        ent = self.srv.read_main(self.ekey(np.arange(self.E))).reshape(
+            self.E, 2 * self.ent_dim)
+        rel = self.srv.read_main(self.rkey(np.arange(self.R))).reshape(
+            self.R, 2 * self.rel_dim)
+        return (ent[:, :self.ent_dim], ent[:, self.ent_dim:],
+                rel[:, :self.rel_dim], rel[:, self.rel_dim:])
+
+    def checkpoint(self, path: str) -> None:
+        ent, ent_acc, rel, rel_acc = self.current_model()
+        np.savez(path, ent=ent, ent_acc=ent_acc, rel=rel, rel_acc=rel_acc)
+        alog(f"[kge] wrote checkpoint {path}")
+
+    # -- PS-key aggregation (reference ps_allreduce, utils.h:163-197) --------
+
+    def allreduce(self, key_l: int, contribution: np.ndarray) -> np.ndarray:
+        """Each worker pushes; after quiesce the main copy holds the sum."""
+        self.workers[0].push(np.array([key_l]),
+                             contribution.astype(np.float32))
+        self.srv.quiesce()
+        return self.srv.read_main(np.array([key_l]))
+
+    def reset_key(self, key_l: int, length: int) -> None:
+        self.workers[0].set(np.array([key_l]),
+                            np.zeros(length, np.float32))
+
+
+def evaluate(run: KgeRun, triples: np.ndarray, batch: int = 64):
+    """Filtered MRR / Hits@{1,10} over `triples`, both-side ranking."""
+    import jax.numpy as jnp
+    ent, _, rel, _ = run.current_model()
+    ent_j, rel_j = jnp.asarray(ent), jnp.asarray(rel)
+    scores_fn = make_eval_scores(run.args.model)
+    sr_o, ro_s = run.ds.filters()
+
+    stats = np.zeros(EVAL_LEN, dtype=np.float64)  # mrr, h1, h10, count
+    for lo in range(0, len(triples), batch):
+        t = triples[lo:lo + batch]
+        s, r, o = t[:, 0], t[:, 1], t[:, 2]
+        so, ss = scores_fn(ent_j, rel_j, ent_j[s], rel_j[r], ent_j[o])
+        so, ss = np.asarray(so), np.asarray(ss)
+        for i in range(len(t)):
+            for side, sc, true_e, flt in (
+                    ("o", so[i], int(o[i]),
+                     sr_o.get((int(s[i]), int(r[i])), set())),
+                    ("s", ss[i], int(s[i]),
+                     ro_s.get((int(r[i]), int(o[i])), set()))):
+                true_score = sc[true_e]
+                mask = np.zeros(len(sc), dtype=bool)
+                if flt:
+                    mask[list(flt)] = True
+                mask[true_e] = False
+                rank = 1 + int((sc[~mask] > true_score).sum())
+                stats[0] += 1.0 / rank
+                stats[1] += rank <= 1
+                stats[2] += rank <= 10
+                stats[3] += 1
+    return stats
+
+
+def run_app(args) -> dict:
+    if args.train:
+        ds = kgeio.load_dataset(args.train, args.valid, args.test,
+                                args.num_entities or None,
+                                args.num_relations or None)
+    else:
+        ds = kgeio.generate_synthetic(
+            num_entities=args.synthetic_entities,
+            num_relations=args.synthetic_relations,
+            n_train=args.synthetic_triples, seed=args.seed)
+    run = KgeRun(args, ds)
+    run.init_model()
+    if args.enforce_full_replication:
+        enforce_full_replication(run.workers, run.E + run.R)
+
+    B, N = args.batch_size, args.neg_ratio
+    srv, workers = run.srv, run.workers
+    # negative sampling: uniform entities (kge.cc draws uniform entities);
+    # the Local scheme may only snap within the entity key population
+    srv.enable_sampling_support(
+        lambda n, r: run.ekey(r.integers(0, run.E, n)),
+        allowed_keys=run.ekey(np.arange(run.E)))
+
+    train = ds.train
+    parts = np.array_split(np.arange(len(train)), run.num_workers)
+    rng = np.random.default_rng(args.seed)
+    guard = RuntimeGuard(args.max_runtime)
+    watch = Stopwatch(start=True)
+    result = {}
+
+    for epoch in range(args.epochs):
+        epoch_loss = 0.0
+        nbatches = 0
+        for wi, w in enumerate(workers):
+            mine = parts[wi]
+            batches = [mine[idx] for idx in
+                       wrap_batches(len(mine), B, rng)]
+            handles = {}
+
+            def prepare(bi: int, ahead: int) -> None:
+                t = train[batches[bi]]
+                ks = np.unique(np.concatenate(
+                    [run.ekey(t[:, 0]), run.rkey(t[:, 1]),
+                     run.ekey(t[:, 2])]))
+                fut = w.current_clock + ahead
+                w.intent(ks, fut, fut + 1)
+                handles[bi] = w.prepare_sample(B * N, fut, fut + 1)
+
+            for bi in range(min(args.lookahead, len(batches))):
+                prepare(bi, ahead=bi)
+            for bi, idx in enumerate(batches):
+                if bi + args.lookahead < len(batches):
+                    prepare(bi + args.lookahead, ahead=args.lookahead)
+                t = train[idx]
+                neg = np.asarray(
+                    w.pull_sample_keys(handles[bi], B * N)).reshape(B, N)
+                w.finish_sample(handles.pop(bi))
+                loss = run.runner(
+                    {"s": run.ekey(t[:, 0]), "r": run.rkey(t[:, 1]),
+                     "o": run.ekey(t[:, 2]), "neg": neg},
+                    None, args.lr, shard=w.shard)
+                epoch_loss += float(loss)
+                nbatches += 1
+                for _ in range(args.sync_rounds_per_step):
+                    srv.sync.run_round()
+                w.advance_clock()
+        srv.quiesce()
+
+        # loss aggregation through the PS loss key (ps_allreduce idiom)
+        total = run.allreduce(run.loss_key_l,
+                              np.array([epoch_loss / max(nbatches, 1)]))
+        run.reset_key(run.loss_key_l, 1)
+        epoch_report("kge", epoch, float(total[0]), watch)
+        result["loss"] = float(total[0])
+
+        if args.eval_every and (epoch + 1) % args.eval_every == 0 and \
+                ds.valid is not None and len(ds.valid):
+            stats = evaluate(run, ds.valid[:args.eval_triples])
+            agg = run.allreduce(run.eval_key_l, stats)
+            run.reset_key(run.eval_key_l, EVAL_LEN)
+            cnt = max(float(agg[3]), 1.0)
+            result.update(mrr=float(agg[0]) / cnt,
+                          hits1=float(agg[1]) / cnt,
+                          hits10=float(agg[2]) / cnt)
+            alog(f"[kge] epoch {epoch}: filtered MRR={result['mrr']:.4f} "
+                 f"Hits@1={result['hits1']:.4f} "
+                 f"Hits@10={result['hits10']:.4f}")
+        if args.checkpoint_every and \
+                (epoch + 1) % args.checkpoint_every == 0:
+            os.makedirs(args.checkpoint_dir, exist_ok=True)
+            run.checkpoint(os.path.join(
+                args.checkpoint_dir, f"kge_epoch{epoch}.npz"))
+        if guard.expired():
+            alog("[kge] max_runtime reached")
+            break
+
+    if ds.test is not None and len(ds.test) and args.eval_every:
+        stats = evaluate(run, ds.test[:args.eval_triples])
+        cnt = max(float(stats[3]), 1.0)
+        result.update(test_mrr=float(stats[0]) / cnt,
+                      test_hits10=float(stats[2]) / cnt)
+        alog(f"[kge] TEST filtered MRR={result['test_mrr']:.4f} "
+             f"Hits@10={result['test_hits10']:.4f}")
+    alog("[kge]", srv.sync.report())
+    srv.shutdown()
+    return result
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--model", default="complex",
+                        choices=["complex", "rescal"])
+    parser.add_argument("--dim", type=int, default=16)
+    parser.add_argument("--neg_ratio", type=int, default=4)
+    parser.add_argument("--train", default=None, help="triples file (s r o)")
+    parser.add_argument("--valid", default=None)
+    parser.add_argument("--test", default=None)
+    parser.add_argument("--num_entities", type=int, default=0)
+    parser.add_argument("--num_relations", type=int, default=0)
+    parser.add_argument("--synthetic_entities", type=int, default=120)
+    parser.add_argument("--synthetic_relations", type=int, default=8)
+    parser.add_argument("--synthetic_triples", type=int, default=1500)
+    parser.add_argument("--lookahead", type=int, default=4,
+                        help="intent/sample batches ahead (kge.cc :1059)")
+    parser.add_argument("--init_scheme", default="normal",
+                        choices=["normal", "uniform"])
+    parser.add_argument("--init_scale", type=float, default=0.1)
+    parser.add_argument("--init_from", default=None,
+                        help="checkpoint .npz to resume from")
+    parser.add_argument("--adagrad_init", type=float, default=1e-6)
+    parser.add_argument("--eval_every", type=int, default=2)
+    parser.add_argument("--eval_triples", type=int, default=500)
+    parser.add_argument("--checkpoint_every", type=int, default=0)
+    parser.add_argument("--checkpoint_dir", default="/tmp/adapm_kge_ckpt")
+    add_common_arguments(parser)
+    return parser
+
+
+def main(argv=None) -> int:
+    run_app(build_parser().parse_args(argv))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

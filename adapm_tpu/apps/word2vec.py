@@ -1,0 +1,219 @@
+"""SGNS word2vec app (reference apps/word2vec.cc).
+
+Two PM keys per word — syn0 (input) = 2w, syn1 (output) = 2w+1
+(word2vec.cc:83-105); unigram^0.75 negative table (:125-144); AdaGrad; the
+logical clock advances per sentence and a read-ahead pipeline (default 1000
+sentences, :561-626) signals `Intent` + `PrepareSample` for future sentences.
+Pair generation for a future sentence is precomputed with a per-sentence
+seeded RNG — the moral equivalent of the reference's PeekableRandom
+(:445-491), which pre-draws future window sizes.
+
+Training pairs accumulate into fixed-size batches for the fused
+gather -> SGNS loss -> AdaGrad -> scatter-add program (ops/fused.py).
+
+Run: python -m adapm_tpu.apps.word2vec --synthetic ...
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+from collections import deque
+from typing import List
+
+import numpy as np
+
+from ..io import text as textio
+from ..models.sgns import (build_unigram_table, sgns_loss, subsample_mask,
+                           syn0_key, syn1_key)
+from ..ops import FusedStepRunner
+from ..utils import Stopwatch, alog
+from .common import (KeyMapper, RuntimeGuard, add_common_arguments,
+                     enforce_full_replication, epoch_report, make_server,
+                     worker0_init)
+
+
+def _pairs_for(sent: np.ndarray, sent_idx: int, window: int, seed: int,
+               counts=None, total: int = 0, sample_t: float = 0.0):
+    """Deterministic pairs for a sentence — identical at intent time and at
+    train time (PeekableRandom pattern). Frequent-word subsampling
+    (word2vec.cc --sample) is applied before pair generation, also
+    deterministically per sentence."""
+    rng = np.random.default_rng(seed * 1_000_003 + sent_idx)
+    if sample_t > 0 and counts is not None:
+        sent = sent[subsample_mask(counts, sent, total, sample_t, rng)]
+    return textio.skipgram_pairs(sent, window, rng)
+
+
+def run(args) -> float:
+    if args.data:
+        corpus = args.data
+    else:
+        corpus = args.synthetic_path or "/tmp/adapm_w2v_corpus.txt"
+        textio.generate_synthetic_corpus(
+            corpus, vocab_size=args.synthetic_vocab,
+            num_sentences=args.synthetic_sentences, seed=args.seed)
+    words, counts, vocab = textio.build_vocab(corpus, args.min_count)
+    total_words = int(counts.sum())
+    V, d = len(words), args.dim
+    if V == 0:
+        raise SystemExit("empty vocabulary")
+    sents: List[np.ndarray] = list(textio.sentences(corpus, vocab))
+    num_keys = 2 * V
+
+    kmap = KeyMapper(num_keys, args.enforce_random_keys, seed=args.seed)
+    srv = make_server(args, num_keys, value_lengths=2 * d,
+                      num_workers=args.num_workers or None)
+    num_workers = args.num_workers or srv.num_shards
+    workers = [srv.make_worker(i) for i in range(num_workers)]
+
+    # init: syn0 ~ U[-.5/d, .5/d], syn1 = 0 (classic w2v); [emb | adagrad]
+    rng = np.random.default_rng(args.seed)
+    init = np.zeros((num_keys, 2 * d), dtype=np.float32)
+    init[syn0_key(np.arange(V)), :d] = \
+        (rng.random((V, d)).astype(np.float32) - 0.5) / d
+    init[:, d:] = args.adagrad_init
+    worker0_init(workers, kmap(np.arange(num_keys)), init)
+    if args.enforce_full_replication:
+        enforce_full_replication(workers, num_keys)
+
+    # negative sampling: unigram^0.75 over words -> syn1 physical keys; the
+    # Local scheme may only snap to other syn1 keys (never syn0)
+    word_sampler = build_unigram_table(counts)
+    srv.enable_sampling_support(
+        lambda n, r: kmap(syn1_key(word_sampler(n, r))),
+        allowed_keys=kmap(syn1_key(np.arange(V))))
+
+    runner = FusedStepRunner(
+        srv, sgns_loss, role_class={"center": 0, "ctx": 0, "neg": 0},
+        role_dim={k: d for k in ("center", "ctx", "neg")})
+
+    B, N = args.batch_size, args.negative
+    guard = RuntimeGuard(args.max_runtime)
+    watch = Stopwatch(start=True)
+    mean_loss = 0.0
+
+    # per-worker contiguous sentence partition (reference :524-531)
+    bounds = np.linspace(0, len(sents), num_workers + 1).astype(int)
+
+    for epoch in range(args.epochs):
+        losses = []
+        for wi, w in enumerate(workers):
+            my = list(range(bounds[wi], bounds[wi + 1]))
+            # (sent position, sample handle) for prepared future sentences
+            prepared: deque = deque()
+            buf_c: List[np.ndarray] = []
+            buf_x: List[np.ndarray] = []
+            buf_n: List[np.ndarray] = []
+
+            def prepare(pos: int, ahead: int) -> None:
+                """Signal intent + prepare negatives for the sentence that
+                will be trained `ahead` clocks from now."""
+                si = my[pos]
+                c, x = _pairs_for(sents[si], si, args.window, args.seed,
+                                  counts, total_words, args.sample)
+                if len(c) == 0:
+                    prepared.append((pos, None, c, x))
+                    return
+                fut = w.current_clock + ahead
+                ks = np.unique(np.concatenate(
+                    [kmap(syn0_key(c)), kmap(syn1_key(x))]))
+                w.intent(ks, fut, fut + 1)
+                h = w.prepare_sample(len(c) * N, fut, fut + 1)
+                prepared.append((pos, h, c, x))
+
+            # prime the pipeline
+            for pos in range(min(args.readahead, len(my))):
+                prepare(pos, ahead=pos)
+
+            n_buf = 0
+            for pos in range(len(my)):
+                if pos + args.readahead < len(my):
+                    prepare(pos + args.readahead, ahead=args.readahead)
+                _, h, c, x = prepared.popleft()
+                if h is not None:
+                    negk = w.pull_sample_keys(h, len(c) * N)
+                    w.finish_sample(h)
+                    buf_c.append(kmap(syn0_key(c)))
+                    buf_x.append(kmap(syn1_key(x)))
+                    buf_n.append(np.asarray(negk).reshape(len(c), N))
+                    n_buf += len(c)
+                while n_buf >= B:
+                    cc = np.concatenate(buf_c)
+                    xx = np.concatenate(buf_x)
+                    nn = np.concatenate(buf_n)
+                    loss = runner({"center": cc[:B], "ctx": xx[:B],
+                                   "neg": nn[:B]}, None, args.lr,
+                                  shard=w.shard)
+                    losses.append(loss)
+                    buf_c, buf_x, buf_n = [cc[B:]], [xx[B:]], [nn[B:]]
+                    n_buf -= B
+                    for _ in range(args.sync_rounds_per_step):
+                        srv.sync.run_round()
+                w.advance_clock()
+            # tail: wrap-pad the remaining pairs into one final batch
+            if n_buf > 0:
+                cc = np.concatenate(buf_c)
+                xx = np.concatenate(buf_x)
+                nn = np.concatenate(buf_n)
+                reps = -(-B // len(cc))
+                loss = runner({"center": np.tile(cc, reps)[:B],
+                               "ctx": np.tile(xx, reps)[:B],
+                               "neg": np.tile(nn, (reps, 1))[:B]},
+                              None, args.lr, shard=w.shard)
+                losses.append(loss)
+        srv.quiesce()
+        mean_loss = float(np.mean([float(l) for l in losses])) \
+            if losses else 0.0
+        epoch_report("w2v", epoch, mean_loss, watch)
+        if args.export_prefix:
+            _export(srv, kmap, words, d,
+                    f"{args.export_prefix}epoch{epoch}.txt")
+        if guard.expired():
+            alog("[w2v] max_runtime reached")
+            break
+
+    alog("[w2v]", srv.sync.report())
+    srv.shutdown()
+    return mean_loss
+
+
+def _export(srv, kmap, words, d, path: str) -> None:
+    """Write syn0 embeddings in the classic word2vec text format (the
+    reference writes epoch embeddings, word2vec.cc:367-416)."""
+    V = len(words)
+    flat = srv.read_main(kmap(syn0_key(np.arange(V))))
+    emb = flat.reshape(V, 2 * d)[:, :d]
+    with open(path, "w") as f:
+        f.write(f"{V} {d}\n")
+        for w, row in zip(words, emb):
+            f.write(w + " " + " ".join(f"{v:.6f}" for v in row) + "\n")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--data", default=None, help="corpus text file")
+    parser.add_argument("--synthetic_path", default=None)
+    parser.add_argument("--synthetic_vocab", type=int, default=200)
+    parser.add_argument("--synthetic_sentences", type=int, default=300)
+    parser.add_argument("--dim", type=int, default=32)
+    parser.add_argument("--window", type=int, default=5)
+    parser.add_argument("--negative", type=int, default=5)
+    parser.add_argument("--min_count", type=int, default=1)
+    parser.add_argument("--sample", type=float, default=1e-3,
+                        help="frequent-word subsampling threshold "
+                             "(word2vec.cc --sample; 0 disables)")
+    parser.add_argument("--readahead", type=int, default=1000,
+                        help="sentences of intent/sample lookahead")
+    parser.add_argument("--adagrad_init", type=float, default=1e-6)
+    parser.add_argument("--export_prefix", default=None)
+    add_common_arguments(parser)
+    return parser
+
+
+def main(argv=None) -> int:
+    run(build_parser().parse_args(argv))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

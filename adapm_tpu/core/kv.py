@@ -142,15 +142,19 @@ class Server:
     # -- sampling ------------------------------------------------------------
 
     def enable_sampling_support(self, sample_key_fn, min_key: int = 0,
-                                max_key: Optional[int] = None) -> None:
+                                max_key: Optional[int] = None,
+                                allowed_keys=None) -> None:
         """Install a sampling scheme (reference
         ColoKVServer::enable_sampling_support, coloc_kv_server.h;
         `sample_key_fn(n, rng) -> np.ndarray[int64]` draws app-distribution
-        keys, like the reference's `Key sample_key()` callback)."""
+        keys, like the reference's `Key sample_key()` callback).
+        `allowed_keys` bounds the Local scheme's snap population when the
+        sampled keys are not a contiguous range."""
         from .sampling import make_sampling
         self.sampling = make_sampling(self, sample_key_fn, min_key,
                                       max_key if max_key is not None
-                                      else self.num_keys)
+                                      else self.num_keys,
+                                      allowed_keys=allowed_keys)
 
     # -- routing helpers (host) ---------------------------------------------
 
@@ -588,6 +592,11 @@ class Worker:
         """Draw n keys (default: all prepared) from sampling handle; returns
         (keys, values[B, L])."""
         return self.server.sampling.pull(self, handle, n)
+
+    def pull_sample_keys(self, handle: int, n: Optional[int] = None):
+        """Draw n keys without fetching values (for fused steps that gather
+        values themselves); locality behavior matches pull_sample."""
+        return self.server.sampling.pull_keys(self, handle, n)
 
     def finish_sample(self, handle: int) -> None:
         self.server.sampling.finish(self, handle)

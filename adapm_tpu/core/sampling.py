@@ -41,11 +41,18 @@ class _Handle:
 
 class SamplingBase:
     def __init__(self, server, sample_key_fn, min_key: int, max_key: int,
-                 seed: int = 42):
+                 allowed_keys: Optional[np.ndarray] = None, seed: int = 42):
         self.server = server
         self.sample_key_fn = sample_key_fn
         self.min_key = min_key
         self.max_key = max_key
+        # Local scheme: the population a drawn key may snap to. The reference
+        # expresses this as the contiguous [min_key, max_key) sampling range
+        # (sampling.h:476-505); with enforce_random_keys the eligible keys
+        # (e.g. entities, syn1 rows) are scattered, so an explicit key set is
+        # needed to keep snapping inside the sampled population.
+        self.allowed_keys = None if allowed_keys is None else \
+            np.unique(np.asarray(allowed_keys, dtype=np.int64))
         self.opts = server.opts
         self._rngs: Dict[int, np.random.Generator] = {}
         self._handles: Dict[Tuple[int, int], _Handle] = {}
@@ -94,13 +101,20 @@ class SamplingBase:
         return hid
 
     def pull(self, worker, hid: int, n: Optional[int] = None):
+        keys = self.pull_keys(worker, hid, n)
+        return keys, worker.pull_sync(keys)
+
+    def pull_keys(self, worker, hid: int, n: Optional[int] = None):
+        """Like pull() but returns only the sampled keys, skipping the value
+        fetch — for callers that gather values themselves inside a fused step
+        (ops/fused.py). Locality behavior per scheme is identical."""
         h = self._handles[(worker.worker_id, hid)]
         n = h.n - h.pos if n is None else n
         assert h.pos + n <= h.n, "pulling more samples than prepared"
-        keys, vals = self._pull(worker, h, n)
+        keys = self._pull_keys(worker, h, n)
         h.pos += n
         self.stats["pulled"] += n
-        return keys, vals
+        return keys
 
     def finish(self, worker, hid: int) -> None:
         self._handles.pop((worker.worker_id, hid), None)
@@ -110,7 +124,7 @@ class SamplingBase:
     def _prepare(self, worker, h: _Handle) -> None:
         pass
 
-    def _pull(self, worker, h: _Handle, n: int):
+    def _pull_keys(self, worker, h: _Handle, n: int) -> np.ndarray:
         raise NotImplementedError
 
 
@@ -123,10 +137,8 @@ class NaiveSampling(SamplingBase):
         else:
             h.keys = self._draw_wor(h.n, worker, h.seen)
 
-    def _pull(self, worker, h: _Handle, n: int):
-        keys = h.keys[h.pos:h.pos + n]
-        vals = worker.pull_sync(keys)
-        return keys, vals
+    def _pull_keys(self, worker, h: _Handle, n: int) -> np.ndarray:
+        return h.keys[h.pos:h.pos + n]
 
 
 class PrelocSampling(NaiveSampling):
@@ -159,7 +171,7 @@ class PoolSampling(SamplingBase):
         clock = worker.current_clock
         worker.intent(fresh, clock, clock + self.reuse)
 
-    def _pull(self, worker, h: _Handle, n: int):
+    def _pull_keys(self, worker, h: _Handle, n: int) -> np.ndarray:
         size = len(self.pool)
         idx = (self._cursor + np.arange(n)) % size
         self._cursor = int((self._cursor + n) % size)
@@ -175,8 +187,7 @@ class PoolSampling(SamplingBase):
                     keys[i] = int(self._draw_wor(1, worker, h.seen)[0])
                 else:
                     h.seen.add(int(k))
-        vals = worker.pull_sync(keys)
-        return keys, vals
+        return keys
 
 
 class LocalSampling(SamplingBase):
@@ -198,7 +209,8 @@ class LocalSampling(SamplingBase):
             self._topo_version = v
         if shard not in self._local_keys:
             ab = srv.ab
-            rng = np.arange(self.min_key, self.max_key, dtype=np.int64)
+            rng = self.allowed_keys if self.allowed_keys is not None else \
+                np.arange(self.min_key, self.max_key, dtype=np.int64)
             local = (ab.owner[rng] == shard) | (
                 ab.cache_slot[shard, rng] != NO_SLOT)
             self._local_keys[shard] = rng[local]
@@ -212,7 +224,7 @@ class LocalSampling(SamplingBase):
         pos = np.where(pos >= len(local), 0, pos)  # wrap (sampling.h:494)
         return local[pos]
 
-    def _pull(self, worker, h: _Handle, n: int):
+    def _pull_keys(self, worker, h: _Handle, n: int) -> np.ndarray:
         if self.opts.sampling_with_replacement:
             keys = self._snap(self._draw(n, worker), worker.shard)
         else:
@@ -236,13 +248,14 @@ class LocalSampling(SamplingBase):
                         k = int(self._draw_wor(1, worker, set(h.seen))[0])
                 h.seen.add(k)
                 keys[i] = k
-        vals = worker.pull_sync(keys)
         self.stats["pulled_local"] += n
-        return keys, vals
+        return keys
 
 
-def make_sampling(server, sample_key_fn, min_key: int, max_key: int):
+def make_sampling(server, sample_key_fn, min_key: int, max_key: int,
+                  allowed_keys=None):
     scheme = server.opts.sampling_scheme
     cls = {"naive": NaiveSampling, "preloc": PrelocSampling,
            "pool": PoolSampling, "local": LocalSampling}[scheme]
-    return cls(server, sample_key_fn, min_key, max_key)
+    return cls(server, sample_key_fn, min_key, max_key,
+               allowed_keys=allowed_keys)

@@ -1,0 +1,3 @@
+"""Data IO: readers, partitioners, and synthetic-data generators for the
+bundled apps (reference apps/mf/io.h, word2vec.cc corpus reader, kge.cc
+dataset loader)."""

@@ -83,3 +83,20 @@ def complex_eval_scores(ent: jnp.ndarray, rel: jnp.ndarray,
     dcoef = rr * oi - ri * orr
     scores_s = c @ er.T + dcoef @ ei.T
     return scores_o, scores_s
+
+
+def rescal_eval_scores(ent: jnp.ndarray, rel: jnp.ndarray,
+                       s: jnp.ndarray, r: jnp.ndarray,
+                       o: jnp.ndarray) -> jnp.ndarray:
+    """All-entity RESCAL scores s^T R e (object side) and e^T R o (subject
+    side) as two matmuls against the full entity matrix [E, d]."""
+    d = ent.shape[-1]
+    R = r.reshape(r.shape[:-1] + (d, d))
+    sR = jnp.einsum("bi,bij->bj", s, R)      # [B, d]
+    Ro = jnp.einsum("bij,bj->bi", R, o)      # [B, d]
+    return sR @ ent.T, Ro @ ent.T
+
+
+def make_eval_scores(model: str):
+    return {"complex": complex_eval_scores,
+            "rescal": rescal_eval_scores}[model]

@@ -48,8 +48,9 @@ def build_unigram_table(counts: np.ndarray, power: float = 0.75):
 
 def subsample_mask(word_counts: np.ndarray, words: np.ndarray,
                    total: int, t: float, rng) -> np.ndarray:
-    """Frequent-word subsampling keep-mask (word2vec.cc uses the classic
-    1 - sqrt(t/f) discard rule)."""
+    """Frequent-word subsampling keep-mask, word2vec.c's keep probability
+    sqrt(t/f) + t/f for a word with corpus frequency f (word2vec.cc applies
+    this while filling its sentence buffer)."""
     f = word_counts[words] / max(total, 1)
     keep_p = np.minimum(1.0, np.sqrt(t / np.maximum(f, 1e-12))
                         + t / np.maximum(f, 1e-12))

@@ -1,0 +1,5 @@
+"""App utility layer (reference include/utils.h + include/dmlc/logging.h)."""
+from .log import alog, verbose_level
+from .stopwatch import Stopwatch
+
+__all__ = ["Stopwatch", "alog", "verbose_level"]

@@ -8,6 +8,13 @@ programs the TPU path compiles. Must run before jax is imported anywhere.
 import os
 
 os.environ["ADAPM_PLATFORM"] = "cpu"  # force CPU even if a TPU plugin is up
+# Keep the TPU-tunnel backend from becoming the default: it adds a large
+# per-dispatch round trip even when every pool array lives on CPU devices.
+# The tunnel's sitecustomize imports jax at interpreter start with
+# JAX_PLATFORMS baked in, so setting the env var here is too late — update
+# the live config instead (backends initialize lazily, so this wins as long
+# as it runs before the first jax.devices()/dispatch).
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
@@ -15,6 +22,10 @@ if "xla_force_host_platform_device_count" not in flags:
 # persistent compilation cache: amortize XLA compiles across pytest sessions
 os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache")
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.1")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

@@ -47,6 +47,8 @@ class SystemOptions:
     # -- observability (sys.stats.*, sys.trace.*)
     stats_out: Optional[str] = None
     trace_keys: Optional[str] = None
+    locality_stats: bool = False     # per-key access counters (PS_LOCALITY_STATS)
+    sync_report_s: float = 10.0      # periodic sync-thread report (0 = off)
 
     # -- sampling (--sampling.*)
     sampling_scheme: str = "local"   # naive | preloc | pool | local
@@ -73,6 +75,10 @@ class SystemOptions:
                        type=float, default=0.0)
         g.add_argument("--sys.stats.out", dest="sys_stats_out", default=None)
         g.add_argument("--sys.trace.keys", dest="sys_trace_keys", default=None)
+        g.add_argument("--sys.stats.locality", dest="sys_stats_locality",
+                       action="store_true")
+        g.add_argument("--sys.sync.report", dest="sys_sync_report",
+                       type=float, default=10.0)
         s = parser.add_argument_group("sampling")
         s.add_argument("--sampling.scheme", dest="sampling_scheme", default="local",
                        choices=["naive", "preloc", "pool", "local"])
@@ -97,6 +103,8 @@ class SystemOptions:
             sync_threshold=args.sys_sync_threshold,
             stats_out=args.sys_stats_out,
             trace_keys=args.sys_trace_keys,
+            locality_stats=args.sys_stats_locality,
+            sync_report_s=args.sys_sync_report,
             sampling_scheme=args.sampling_scheme,
             sampling_reuse_factor=args.sampling_reuse,
             sampling_pool_size=args.sampling_pool_size,

@@ -112,6 +112,21 @@ class Server:
 
         self.sampling = None  # set by enable_sampling_support
 
+        # observability (reference PS_TRACE_KEYS / PS_LOCALITY_STATS, §5)
+        from ..utils.stats import (KeyTracer, LocalityStats, ALLOC,
+                                   parse_trace_spec)
+        traced = parse_trace_spec(self.opts.trace_keys or "", self.num_keys)
+        self.tracer = KeyTracer(traced, self.num_keys) \
+            if traced is not None else None
+        self.locality = LocalityStats(self.num_keys) \
+            if self.opts.locality_stats else None
+        if self.tracer is not None:
+            # initial allocation events, grouped by home shard (one record
+            # call per shard, not per key)
+            owners = self.ab.owner[traced]
+            for s in np.unique(owners):
+                self.tracer.record(traced[owners == s], ALLOC, int(s))
+
     # -- worker management ---------------------------------------------------
 
     def make_worker(self, worker_id: Optional[int] = None) -> "Worker":
@@ -158,18 +173,26 @@ class Server:
 
     # -- routing helpers (host) ---------------------------------------------
 
-    def _route(self, keys: np.ndarray, shard: int):
+    def _route(self, keys: np.ndarray, shard: int,
+               write_through: bool = False):
         """Resolve keys (any shape) to pool coordinates for a worker on
         `shard`, preferring a local replica over the owner row (the single
         routing policy shared by Pull/Push and the fused step, ops/fused.py).
         Returns (o_sh, o_sl, c_sh, c_sl, use_c, n_remote): owner shard+slot,
-        replica shard+slot (OOB where none), replica mask, remote-key count."""
+        replica shard+slot (OOB where none), replica mask, remote-key count.
+        Locality stats are recorded here (the one place all data-plane ops
+        pass through); `write_through` marks ops that must reach the owner
+        regardless of replicas (Set), so a replica doesn't count as local."""
         ab = self.ab
         o_sh = ab.owner[keys].astype(np.int32)
         o_sl = ab.slot[keys].astype(np.int32)
         cs = ab.cache_slot[shard, keys].astype(np.int32)
         use_c = cs >= 0
-        n_remote = int((~(use_c | (o_sh == shard))).sum())
+        on_owner = o_sh == shard
+        local = on_owner if write_through else (use_c | on_owner)
+        n_remote = int((~local).sum())
+        if self.locality is not None:
+            self.locality.record(keys.ravel(), local.ravel())
         c_sh = np.full_like(o_sh, shard)
         c_sl = np.where(use_c, cs, OOB).astype(np.int32)
         return o_sh, o_sl, c_sh, c_sl, use_c, n_remote
@@ -221,11 +244,12 @@ class Server:
                 rows = self._flat_parts(keys, vals, pos, L)
             else:
                 rows = vals[pos]
-            o_sh, o_sl, c_sh, c_sl, use_c, nr = self._route(ks, shard)
+            o_sh, o_sl, c_sh, c_sl, use_c, nr = self._route(
+                ks, shard, write_through=is_set)
             if is_set:
                 # Set writes through to the main copy and refreshes the
                 # writer's local replica (store._set_rows docstring)
-                n_remote += int((o_sh != shard).sum())
+                n_remote += nr
                 self.stores[cid].set_rows(o_sh, o_sl, rows, c_sh, c_sl)
             else:
                 n_remote += nr
@@ -263,6 +287,9 @@ class Server:
                 created.extend(int(k) for k in ks)
             if created:
                 self.topology_version += 1
+                if self.tracer is not None:
+                    from ..utils.stats import REPLICA_SETUP
+                    self.tracer.record(created, REPLICA_SETUP, shard)
             return created
 
     def _sync_replicas(self, items: List[Tuple[int, int]]) -> None:
@@ -284,6 +311,9 @@ class Server:
             self._sync_replicas(items)
             for k, s in items:
                 self.ab.drop_replica(int(k), int(s))
+                if self.tracer is not None:
+                    from ..utils.stats import REPLICA_DROP
+                    self.tracer.record(k, REPLICA_DROP, int(s))
             self.topology_version += 1
 
     def _relocate(self, moves: List[Tuple[int, int]]) -> int:
@@ -319,6 +349,9 @@ class Server:
                     osh, osl, nsl = ab.relocate(k, s)
                     old_sh.append(osh); old_sl.append(osl)
                     new_sh.append(s); new_sl.append(nsl)
+                    if self.tracer is not None:
+                        from ..utils.stats import RELOCATE
+                        self.tracer.record(k, RELOCATE, s)
                 if not old_sh:
                     continue
                 self.stores[cid].relocate_rows(
@@ -344,8 +377,21 @@ class Server:
         self._sync_stop.clear()
 
         def loop():
+            import time as _time
+            from ..utils import alog
+            last_report = _time.monotonic()
+            last_rounds = 0
             while not self._sync_stop.is_set():
                 self.sync.run_round()
+                # periodic report (reference SyncManager 10-second reports,
+                # sync_manager.h:482-497)
+                rs = self.opts.sync_report_s
+                now = _time.monotonic()
+                if rs > 0 and now - last_report >= rs:
+                    dr = self.sync.stats.rounds - last_rounds
+                    alog(f"[sync] {dr / (now - last_report):.1f} rounds/s | "
+                         + self.sync.report())
+                    last_report, last_rounds = now, self.sync.stats.rounds
 
         self._sync_thread = threading.Thread(target=loop, daemon=True,
                                              name="adapm-sync")
@@ -370,6 +416,41 @@ class Server:
     def shutdown(self) -> None:
         self.stop_sync_thread()
         self.block()
+        self.write_stats()
+
+    def locality_summary(self) -> Dict[str, float]:
+        """Aggregate worker op/param locality ratios (reference shutdown
+        summary, coloc_kv_server.h:147-157)."""
+        agg: Dict[str, int] = {}
+        for w in self._workers.values():
+            for k, v in w.stats.items():
+                agg[k] = agg.get(k, 0) + v
+        out = {}
+        for kind in ("pull", "push"):
+            for unit in ("ops", "params"):
+                tot = agg.get(f"{kind}_{unit}", 0)
+                loc = agg.get(f"{kind}_{unit}_local", 0)
+                out[f"{kind}_{unit}_local_frac"] = \
+                    loc / tot if tot else float("nan")
+        return out
+
+    def write_stats(self) -> List[str]:
+        """Dump trace/locality files into --sys.stats.out and log the final
+        locality + sync summary."""
+        from ..utils import alog, verbose_level
+        enabled = bool(self.opts.stats_out or self.tracer is not None
+                       or self.locality is not None or verbose_level() > 0)
+        if enabled:
+            summ = self.locality_summary()
+            if any(v == v for v in summ.values()):  # any non-nan
+                alog("[stats] " + " ".join(f"{k}={v:.3f}" for k, v in
+                                           summ.items() if v == v))
+            alog("[stats]", self.sync.report())
+        if not self.opts.stats_out:
+            return []
+        from ..utils.stats import write_stats
+        return write_stats(self.opts.stats_out, 0, self.tracer,
+                           self.locality)
 
     def wait_sync(self) -> None:
         """Act on all signalled intents and complete a full sync round

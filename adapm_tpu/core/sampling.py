@@ -114,6 +114,8 @@ class SamplingBase:
         keys = self._pull_keys(worker, h, n)
         h.pos += n
         self.stats["pulled"] += n
+        if self.server.locality is not None:
+            self.server.locality.record_sampling(keys)
         return keys
 
     def finish(self, worker, hid: int) -> None:

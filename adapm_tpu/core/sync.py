@@ -110,6 +110,9 @@ class SyncManager:
         ab = self.server.ab
         ie = self.intent_end
         np.maximum.at(ie[shard], keys, end)
+        if self.server.tracer is not None:
+            from ..utils.stats import INTENT_START
+            self.server.tracer.record(keys, INTENT_START, shard)
         # keys that are not yet available on `shard`
         nonlocal_mask = ~ab.is_local(keys, shard)
         for k in keys[nonlocal_mask]:
@@ -177,6 +180,10 @@ class SyncManager:
             self.server._sync_replicas(keep)
             self.stats.keys_synced += len(keep)
         if drop:
+            if self.server.tracer is not None:
+                from ..utils.stats import INTENT_STOP
+                for k, s in drop:
+                    self.server.tracer.record(k, INTENT_STOP, s)
             self.server._drop_replicas(drop)
             for item in drop:
                 reps.discard(item)

@@ -1,0 +1,90 @@
+"""Tracing / locality-stats tests (reference §5: PS_TRACE_KEYS trace events
+-> traces.<rank>.tsv, PS_LOCALITY_STATS counters ->
+locality_stats.rank.<r>.tsv, sync shutdown report)."""
+import numpy as np
+
+import adapm_tpu
+from adapm_tpu.base import CLOCK_MAX
+from adapm_tpu.config import SystemOptions
+from adapm_tpu.utils.stats import parse_trace_spec
+
+
+def test_parse_trace_spec():
+    assert len(parse_trace_spec("all", 10)) == 10
+    ks = parse_trace_spec("3,7,7,1", 10)
+    assert ks.tolist() == [1, 3, 7]
+    r = parse_trace_spec("random-5-seed-3-range-0-100", 1000)
+    assert len(r) <= 5 and r.max() < 100
+    assert parse_trace_spec("", 10) is None
+
+
+def test_trace_events_and_locality_files(tmp_path):
+    opts = SystemOptions(trace_keys="all", locality_stats=True,
+                         stats_out=str(tmp_path), sync_max_per_sec=0,
+                         cache_slots_per_shard=16)
+    srv = adapm_tpu.setup(32, 4, opts=opts)
+    w0 = srv.make_worker(0)
+    w1 = srv.make_worker(1)
+
+    keys = np.arange(8, dtype=np.int64)
+    w0.set(keys, np.ones((8, 4), np.float32))
+    w0.pull_sync(keys)
+    # both workers want key 5 -> replication; only w0 wants key 9 -> may
+    # relocate
+    w0.intent(np.array([5]), 0, CLOCK_MAX)
+    w1.intent(np.array([5]), 0, CLOCK_MAX)
+    w0.intent(np.array([9]), 0, CLOCK_MAX)
+    srv.wait_sync()
+    w0.pull_sync(np.array([5, 9]))
+    files = srv.write_stats()
+    srv.shutdown()
+
+    paths = {p.split("/")[-1] for p in files}
+    assert "traces.0.tsv" in paths
+    assert "locality_stats.rank.0.tsv" in paths
+
+    trace = (tmp_path / "traces.0.tsv").read_text().splitlines()
+    events = {ln.split("\t")[2] for ln in trace[1:]}
+    assert "ALLOC" in events and "INTENT_START" in events
+    assert ("REPLICA_SETUP" in events) or ("RELOCATE" in events)
+
+    loc = (tmp_path / "locality_stats.rank.0.tsv").read_text().splitlines()
+    assert loc[0].startswith("key\taccesses")
+    rows = {int(ln.split("\t")[0]): [int(x) for x in ln.split("\t")[1:]]
+            for ln in loc[1:]}
+    # every access count >= local count
+    for k, (acc, local, _samp) in rows.items():
+        assert acc >= local
+
+
+def test_locality_counts_fused_path(tmp_path):
+    """The fused-step routing records locality too (the hot loop is where
+    the reference counts most accesses)."""
+    import jax.numpy as jnp
+    from adapm_tpu.ops import FusedStepRunner
+
+    opts = SystemOptions(locality_stats=True, sync_max_per_sec=0)
+    srv = adapm_tpu.setup(16, 8, opts=opts)
+    w = srv.make_worker(0)
+    w.set(np.arange(16), np.ones((16, 8), np.float32))
+
+    def loss_fn(embs, aux):
+        return (embs["x"] ** 2).mean()
+
+    runner = FusedStepRunner(srv, loss_fn, role_class={"x": 0},
+                             role_dim={"x": 4})
+    runner({"x": np.arange(8, dtype=np.int64)}, None, 0.1)
+    assert int(srv.locality.accesses.sum()) >= 8
+    summ = srv.locality_summary()
+    srv.shutdown()
+
+
+def test_sync_report_string():
+    opts = SystemOptions(sync_max_per_sec=0)
+    srv = adapm_tpu.setup(8, 2, opts=opts)
+    w = srv.make_worker(0)
+    w.intent(np.arange(4), 0, 10)
+    srv.wait_sync()
+    rep = srv.sync.report()
+    assert "rounds=" in rep and "intents=" in rep
+    srv.shutdown()

@@ -406,8 +406,15 @@ class Server:
 
     def barrier(self) -> None:
         """Process barrier. Single-controller: flush dispatch. Multi-host:
-        control-plane barrier (parallel/control.py)."""
-        self.block()
+        control-plane barrier (parallel/control.py replaces the reference's
+        scheduler BARRIER protocol, src/postoffice.cc:149-174)."""
+        from ..parallel import control
+        # hold the server lock so the background sync thread cannot enqueue
+        # sync collectives between block() and the barrier collective —
+        # cross-host collective order must be identical on every host
+        with self._lock:
+            self.block()
+            control.barrier()
 
     def block(self) -> None:
         for s in self.stores:

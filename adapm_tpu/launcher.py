@@ -1,0 +1,144 @@
+"""Cluster launcher: the tracker reborn (reference tracker/{tracker.py,
+dmlc_local.py,dmlc_ssh.py,dmlc_mpi.py}).
+
+Spawns N copies of a program with the env contract consumed by
+`adapm_tpu.parallel.control.init_from_env` (ADAPM_COORDINATOR /
+ADAPM_NUM_PROCESSES / ADAPM_PROCESS_ID — the analog of the reference's
+DMLC_PS_ROOT_URI/PORT + DMLC_ROLE env rendezvous, docs/env.md). There is no
+separate scheduler process: process 0's coordinator service (gRPC inside
+jax.distributed) plays that role.
+
+Modes:
+  local  N subprocesses on this machine (reference dmlc_local.py), with the
+         keepalive contract: a process exiting with code 254 is restarted
+         (dmlc_local.py:15-25).
+  ssh    fan out over ssh using a hostfile, one process per line
+         (reference dmlc_ssh.py).
+  mpi    delegate process placement to mpirun (reference dmlc_mpi.py).
+
+Usage: python -m adapm_tpu.launcher -n 2 -- python my_app.py --epochs 4
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import shlex
+import socket
+import subprocess
+import sys
+import threading
+import time
+from typing import Dict, List, Optional
+
+KEEPALIVE_EXIT_CODE = 254  # reference dmlc_local.py restart contract
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+def make_env(rank: int, num: int, coordinator: str,
+             base: Optional[Dict[str, str]] = None) -> Dict[str, str]:
+    env = dict(base if base is not None else os.environ)
+    env["ADAPM_COORDINATOR"] = coordinator
+    env["ADAPM_NUM_PROCESSES"] = str(num)
+    env["ADAPM_PROCESS_ID"] = str(rank)
+    return env
+
+
+def launch_local(n: int, cmd: List[str], keepalive: bool = True,
+                 coordinator: Optional[str] = None) -> int:
+    """Run n copies locally; returns the first nonzero exit code (0 if all
+    succeed). Keepalive restarts rank processes that exit with 254."""
+    coordinator = coordinator or f"localhost:{free_port()}"
+    codes = [0] * n
+    threads = []
+
+    def run(rank: int) -> None:
+        while True:
+            p = subprocess.Popen(cmd, env=make_env(rank, n, coordinator))
+            p.wait()
+            if keepalive and p.returncode == KEEPALIVE_EXIT_CODE:
+                time.sleep(0.5)
+                continue
+            codes[rank] = p.returncode
+            return
+
+    for r in range(n):
+        t = threading.Thread(target=run, args=(r,), daemon=True)
+        t.start()
+        threads.append(t)
+    for t in threads:
+        t.join()
+    return next((c for c in codes if c != 0), 0)
+
+
+def launch_ssh(hosts: List[str], cmd: List[str], coordinator_port: int = 0,
+               ssh_opts: str = "-o StrictHostKeyChecking=no") -> int:
+    """One process per host line (reference dmlc_ssh.py). The first host
+    runs process 0 and the coordinator."""
+    n = len(hosts)
+    port = coordinator_port or free_port()
+    coordinator = f"{hosts[0]}:{port}"
+    procs = []
+    for rank, host in enumerate(hosts):
+        envs = " ".join(
+            f"{k}={shlex.quote(v)}"
+            for k, v in [("ADAPM_COORDINATOR", coordinator),
+                         ("ADAPM_NUM_PROCESSES", str(n)),
+                         ("ADAPM_PROCESS_ID", str(rank))])
+        remote = f"cd {shlex.quote(os.getcwd())} && {envs} " + \
+            " ".join(shlex.quote(c) for c in cmd)
+        procs.append(subprocess.Popen(
+            ["ssh"] + ssh_opts.split() + [host, remote]))
+    code = 0
+    for p in procs:
+        p.wait()
+        code = code or p.returncode
+    return code
+
+
+def launch_mpi(n: int, cmd: List[str], mpirun: str = "mpirun") -> int:
+    """Delegate to mpirun (reference dmlc_mpi.py): ranks come from
+    OMPI_COMM_WORLD_RANK et al; we translate via a tiny bootstrap that maps
+    MPI env to the ADAPM contract."""
+    coordinator = f"{socket.gethostname()}:{free_port()}"
+    boot = (
+        "import os,subprocess,sys;"
+        "r=os.environ.get('OMPI_COMM_WORLD_RANK') or "
+        "os.environ.get('PMI_RANK') or '0';"
+        f"os.environ['ADAPM_COORDINATOR']='{coordinator}';"
+        f"os.environ['ADAPM_NUM_PROCESSES']='{n}';"
+        "os.environ['ADAPM_PROCESS_ID']=r;"
+        f"sys.exit(subprocess.call({cmd!r}))")
+    return subprocess.call([mpirun, "-n", str(n), sys.executable, "-c", boot])
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("-n", "--num-processes", type=int, default=1)
+    parser.add_argument("--mode", choices=["local", "ssh", "mpi"],
+                        default="local")
+    parser.add_argument("--hostfile", default=None,
+                        help="ssh mode: one host per line")
+    parser.add_argument("--no-keepalive", action="store_true")
+    parser.add_argument("cmd", nargs=argparse.REMAINDER,
+                        help="program to launch (prefix with --)")
+    args = parser.parse_args(argv)
+    cmd = args.cmd[1:] if args.cmd and args.cmd[0] == "--" else args.cmd
+    if not cmd:
+        parser.error("no command given")
+    if args.mode == "local":
+        return launch_local(args.num_processes, cmd,
+                            keepalive=not args.no_keepalive)
+    if args.mode == "ssh":
+        with open(args.hostfile) as f:
+            hosts = [h.strip() for h in f if h.strip()]
+        return launch_ssh(hosts, cmd)
+    return launch_mpi(args.num_processes, cmd)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

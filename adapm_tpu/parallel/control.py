@@ -1,0 +1,110 @@
+"""Multi-host control plane.
+
+Replaces the reference's scheduler + Van control machinery (ADD_NODE
+rendezvous, BARRIER counting, heartbeats — src/van.cc:40-210,
+src/postoffice.cc:149-187) with JAX's distributed runtime: the coordinator
+service (`jax.distributed.initialize`) plays the scheduler, process ranks
+replace node ids, and barriers/aggregations ride the coordinator's gRPC
+channel or device collectives. ZeroMQ is gone entirely; data-plane traffic
+is XLA collectives over ICI/DCN (see ARCHITECTURE.md).
+
+All primitives degrade to no-ops / local computation in a single-process
+run, so the same app code runs on one host or many.
+
+`allreduce` is the replacement for the reference's PS-based scalar/vector
+allreduce (`ps_allreduce`, include/utils.h:163-197) used by the apps for
+loss/eval aggregation.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import numpy as np
+
+# env names follow the launcher contract (launcher.py), mirroring the
+# reference's DMLC_* topology env vars (docs/env.md)
+ENV_COORD = "ADAPM_COORDINATOR"       # host:port of process 0
+ENV_NUM_PROCS = "ADAPM_NUM_PROCESSES"
+ENV_PROC_ID = "ADAPM_PROCESS_ID"
+
+
+def init_from_env() -> bool:
+    """Initialize `jax.distributed` from launcher env vars; returns True if
+    a multi-process runtime was set up (reference Postoffice::Start +
+    Van ADD_NODE handshake, collapsed into one call)."""
+    coord = os.environ.get(ENV_COORD)
+    if not coord:
+        return False
+    n = int(os.environ[ENV_NUM_PROCS])
+    pid = int(os.environ[ENV_PROC_ID])
+    if n <= 1:
+        return False
+    import jax
+    jax.distributed.initialize(coordinator_address=coord, num_processes=n,
+                               process_id=pid)
+    return True
+
+
+def num_processes() -> int:
+    import jax
+    return jax.process_count()
+
+
+def process_id() -> int:
+    import jax
+    return jax.process_index()
+
+
+def barrier(name: str = "adapm") -> None:
+    """Global process barrier (reference Postoffice::Barrier via the
+    scheduler, src/postoffice.cc:149-174)."""
+    import jax
+    if jax.process_count() == 1:
+        return
+    from jax.experimental import multihost_utils
+    multihost_utils.sync_global_devices(name)
+
+
+def allreduce(values, op: str = "sum") -> np.ndarray:
+    """Sum/mean/max a host scalar or vector across processes (reference
+    ps_allreduce, include/utils.h:163-197: push to a shared PS key, barrier,
+    pull). Single-process: returns the input unchanged (as float64 array)."""
+    import jax
+    arr = np.atleast_1d(np.asarray(values, dtype=np.float64))
+    if jax.process_count() == 1:
+        return arr if op != "mean" else arr / 1.0
+    from jax.experimental import multihost_utils
+    gathered = multihost_utils.process_allgather(arr)  # [P, ...]
+    if op == "sum":
+        return np.asarray(gathered).sum(axis=0)
+    if op == "mean":
+        return np.asarray(gathered).mean(axis=0)
+    if op == "max":
+        return np.asarray(gathered).max(axis=0)
+    raise ValueError(f"unknown allreduce op {op}")
+
+
+def broadcast(values, root: int = 0) -> np.ndarray:
+    """Broadcast a host array from `root` to all processes (worker-0
+    initialization across hosts)."""
+    import jax
+    arr = np.asarray(values)
+    if jax.process_count() == 1:
+        return arr
+    from jax.experimental import multihost_utils
+    return np.asarray(multihost_utils.broadcast_one_to_all(
+        arr, is_source=jax.process_index() == root))
+
+
+def intent_summary_allgather(local_summary: np.ndarray) -> np.ndarray:
+    """Exchange per-host intent summaries so every host's planner sees the
+    global interest picture (the multi-host analog of the reference's
+    per-sender node_intent sets, sync_manager.h:182, 571, 644).
+    local_summary is any fixed-shape numeric array; returns [P, ...]."""
+    import jax
+    arr = np.atleast_1d(np.asarray(local_summary))
+    if jax.process_count() == 1:
+        return arr[None]
+    from jax.experimental import multihost_utils
+    return np.asarray(multihost_utils.process_allgather(arr))

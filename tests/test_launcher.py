@@ -1,0 +1,104 @@
+"""Launcher + multi-host control plane tests (reference tracker/dmlc_local.py
+thread-per-process launch, keepalive restart on exit code 254, and the
+scheduler barrier/allreduce protocol — SURVEY.md §2.4, §4)."""
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+from adapm_tpu import launcher
+from adapm_tpu.parallel import control
+
+
+def test_control_single_process_fallbacks():
+    """All control primitives degrade to local no-ops in one process."""
+    control.barrier("t")
+    assert control.allreduce(3.0, "sum").tolist() == [3.0]
+    assert control.allreduce([1.0, 2.0], "mean").tolist() == [1.0, 2.0]
+    assert control.broadcast(np.arange(3)).tolist() == [0, 1, 2]
+    assert control.intent_summary_allgather(np.arange(2)).shape == (1, 2)
+    assert control.num_processes() == 1
+    assert control.process_id() == 0
+
+
+def test_launch_local_env_contract(tmp_path):
+    """launch_local spawns N ranks with the ADAPM_* env contract."""
+    out = tmp_path / "ranks"
+    out.mkdir()
+    script = tmp_path / "prog.py"
+    script.write_text(textwrap.dedent(f"""
+        import os
+        rank = os.environ["ADAPM_PROCESS_ID"]
+        n = os.environ["ADAPM_NUM_PROCESSES"]
+        coord = os.environ["ADAPM_COORDINATOR"]
+        open(r"{out}" + "/" + rank, "w").write(n + " " + coord)
+    """))
+    code = launcher.launch_local(3, [sys.executable, str(script)])
+    assert code == 0
+    files = sorted(os.listdir(out))
+    assert files == ["0", "1", "2"]
+    contents = {(out / f).read_text() for f in files}
+    assert len(contents) == 1  # same num + coordinator for all ranks
+
+
+def test_launch_local_keepalive(tmp_path):
+    """Exit code 254 triggers a restart (reference dmlc_local.py:15-25)."""
+    marker = tmp_path / "ran_once"
+    script = tmp_path / "prog.py"
+    script.write_text(textwrap.dedent(f"""
+        import os, sys
+        m = r"{marker}"
+        if not os.path.exists(m):
+            open(m, "w").write("x")
+            sys.exit(254)
+        sys.exit(0)
+    """))
+    code = launcher.launch_local(1, [sys.executable, str(script)])
+    assert code == 0 and marker.exists()
+
+
+def test_launch_local_propagates_failure(tmp_path):
+    script = tmp_path / "prog.py"
+    script.write_text("import sys; sys.exit(7)")
+    assert launcher.launch_local(
+        2, [sys.executable, str(script)], keepalive=False) == 7
+
+
+@pytest.mark.slow
+def test_two_process_distributed_allreduce(tmp_path):
+    """Real 2-process rendezvous through the jax.distributed coordinator
+    (the scheduler's replacement): each rank contributes rank+1; the
+    allreduce sum must be 3 in both processes."""
+    script = tmp_path / "prog.py"
+    script.write_text(textwrap.dedent("""
+        import os
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ.pop("PYTHONPATH", None)
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        from adapm_tpu.parallel import control
+        assert control.init_from_env()
+        rank = control.process_id()
+        control.barrier("start")
+        total = control.allreduce(float(rank + 1), "sum")
+        assert total.tolist() == [3.0], total
+        control.barrier("end")
+        print("RANK", rank, "OK", flush=True)
+    """))
+    env = dict(os.environ)
+    # child processes need the repo importable but NOT the TPU-tunnel site
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
+        os.path.abspath(launcher.__file__)))
+    coordinator = f"localhost:{launcher.free_port()}"
+    procs = [subprocess.Popen(
+        [sys.executable, str(script)],
+        env=launcher.make_env(r, 2, coordinator, env),
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        for r in range(2)]
+    outs = [p.communicate(timeout=120)[0].decode() for p in procs]
+    for r, (p, o) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {r} failed:\n{o}"
+        assert f"RANK {r} OK" in o

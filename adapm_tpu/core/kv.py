@@ -409,16 +409,26 @@ class Server:
         control-plane barrier (parallel/control.py replaces the reference's
         scheduler BARRIER protocol, src/postoffice.cc:149-174)."""
         from ..parallel import control
-        # hold the server lock so the background sync thread cannot enqueue
-        # sync collectives between block() and the barrier collective —
-        # cross-host collective order must be identical on every host
-        with self._lock:
-            self.block()
-            control.barrier()
+        # Pause the background sync thread across the cross-host barrier:
+        # its rounds dispatch device programs, and the barrier collective
+        # must not interleave with them. (Today each process owns its own
+        # pools, so sync programs are process-local and the barrier is the
+        # only cross-host collective; once pools span hosts, sync rounds
+        # themselves must be driven at globally agreed points.)
+        was_running = self._sync_thread is not None
+        if was_running:
+            self.stop_sync_thread()
+        self.block()
+        control.barrier()
+        if was_running:
+            self.start_sync_thread()
 
     def block(self) -> None:
-        for s in self.stores:
-            s.block()
+        # under the server lock: pool buffers are donated+replaced by ops
+        # running in other threads, and blocking on a donated buffer raises
+        with self._lock:
+            for s in self.stores:
+                s.block()
 
     def shutdown(self) -> None:
         self.stop_sync_thread()
@@ -642,7 +652,8 @@ class Worker:
             return True
         entry = self._pending[ts]
         if entry.is_write:
-            return all(s.main.is_ready() for s in self.server.stores)
+            with self.server._lock:
+                return all(s.main.is_ready() for s in self.server.stores)
         return all(g[3].is_ready() for g in entry.groups)
 
     def wait_sync(self) -> None:

@@ -75,12 +75,20 @@ def launch_local(n: int, cmd: List[str], keepalive: bool = True,
     return next((c for c in codes if c != 0), 0)
 
 
+def remote_port(seed: Optional[int] = None) -> int:
+    """A port for a coordinator that binds on a REMOTE machine: probing a
+    local free port (free_port) says nothing about the remote host, so pick
+    from a high range instead; pass --coordinator-port to pin one."""
+    import random
+    return random.Random(seed).randint(20000, 39999)
+
+
 def launch_ssh(hosts: List[str], cmd: List[str], coordinator_port: int = 0,
                ssh_opts: str = "-o StrictHostKeyChecking=no") -> int:
     """One process per host line (reference dmlc_ssh.py). The first host
     runs process 0 and the coordinator."""
     n = len(hosts)
-    port = coordinator_port or free_port()
+    port = coordinator_port or remote_port()
     coordinator = f"{hosts[0]}:{port}"
     procs = []
     for rank, host in enumerate(hosts):
@@ -100,11 +108,13 @@ def launch_ssh(hosts: List[str], cmd: List[str], coordinator_port: int = 0,
     return code
 
 
-def launch_mpi(n: int, cmd: List[str], mpirun: str = "mpirun") -> int:
+def launch_mpi(n: int, cmd: List[str], mpirun: str = "mpirun",
+               coordinator_port: int = 0) -> int:
     """Delegate to mpirun (reference dmlc_mpi.py): ranks come from
     OMPI_COMM_WORLD_RANK et al; we translate via a tiny bootstrap that maps
-    MPI env to the ADAPM contract."""
-    coordinator = f"{socket.gethostname()}:{free_port()}"
+    MPI env to the ADAPM contract. Rank 0 may land on another host, so the
+    coordinator port comes from remote_port()."""
+    coordinator = f"{socket.gethostname()}:{coordinator_port or remote_port()}"
     boot = (
         "import os,subprocess,sys;"
         "r=os.environ.get('OMPI_COMM_WORLD_RANK') or "
@@ -123,6 +133,8 @@ def main(argv=None) -> int:
                         default="local")
     parser.add_argument("--hostfile", default=None,
                         help="ssh mode: one host per line")
+    parser.add_argument("--coordinator-port", type=int, default=0,
+                        help="pin the coordinator port (ssh/mpi modes)")
     parser.add_argument("--no-keepalive", action="store_true")
     parser.add_argument("cmd", nargs=argparse.REMAINDER,
                         help="program to launch (prefix with --)")
@@ -136,8 +148,9 @@ def main(argv=None) -> int:
     if args.mode == "ssh":
         with open(args.hostfile) as f:
             hosts = [h.strip() for h in f if h.strip()]
-        return launch_ssh(hosts, cmd)
-    return launch_mpi(args.num_processes, cmd)
+        return launch_ssh(hosts, cmd, coordinator_port=args.coordinator_port)
+    return launch_mpi(args.num_processes, cmd,
+                      coordinator_port=args.coordinator_port)
 
 
 if __name__ == "__main__":

@@ -71,18 +71,15 @@ def allreduce(values, op: str = "sum") -> np.ndarray:
     ps_allreduce, include/utils.h:163-197: push to a shared PS key, barrier,
     pull). Single-process: returns the input unchanged (as float64 array)."""
     import jax
+    if op not in ("sum", "mean", "max"):
+        raise ValueError(f"unknown allreduce op {op}")
     arr = np.atleast_1d(np.asarray(values, dtype=np.float64))
     if jax.process_count() == 1:
-        return arr if op != "mean" else arr / 1.0
+        return arr
     from jax.experimental import multihost_utils
-    gathered = multihost_utils.process_allgather(arr)  # [P, ...]
-    if op == "sum":
-        return np.asarray(gathered).sum(axis=0)
-    if op == "mean":
-        return np.asarray(gathered).mean(axis=0)
-    if op == "max":
-        return np.asarray(gathered).max(axis=0)
-    raise ValueError(f"unknown allreduce op {op}")
+    gathered = np.asarray(multihost_utils.process_allgather(arr))  # [P, ...]
+    return {"sum": gathered.sum, "mean": gathered.mean,
+            "max": gathered.max}[op](axis=0)
 
 
 def broadcast(values, root: int = 0) -> np.ndarray:

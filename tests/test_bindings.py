@@ -1,0 +1,121 @@
+"""Bindings-surface tests (reference bindings/bindings.cc + example.py:
+torch/numpy zero-copy ops, async flags, validation errors, built-in
+sampling distributions)."""
+import numpy as np
+import pytest
+import torch
+
+from adapm_tpu import bindings as adapm
+from adapm_tpu.base import LOCAL
+
+
+@pytest.fixture
+def server():
+    adapm.setup(50, 2, use_techniques="all", num_channels=2)
+    s = adapm.Server(4, num_keys=50)
+    yield s
+    s.shutdown()
+
+
+def test_pull_push_torch_tensor(server):
+    w = adapm.Worker(0, server)
+    keys = torch.tensor([1, 2, 3], dtype=torch.int64)
+    vals = torch.zeros(3, 4)
+    w.pull(keys, vals)
+    assert vals.abs().sum() == 0
+    w.push(keys, torch.ones(3, 4))
+    w.pull(keys, vals)
+    assert torch.allclose(vals, torch.ones(3, 4))
+    # in-place: the same tensor object is filled (zero-copy contract)
+    w.push(keys, torch.full((3, 4), 2.0))
+    w.pull(keys, vals)
+    assert torch.allclose(vals, torch.full((3, 4), 3.0))
+
+
+def test_pull_push_numpy(server):
+    w = adapm.Worker(0, server)
+    keys = np.array([7, 8], dtype=np.int64)
+    vals = np.zeros((2, 4), dtype=np.float32)
+    w.set(keys, np.full((2, 4), 5.0, dtype=np.float32))
+    w.pull(keys, vals)
+    assert np.allclose(vals, 5.0)
+
+
+def test_async_contract(server):
+    w = adapm.Worker(0, server)
+    keys = torch.tensor([10], dtype=torch.int64)
+    vals = torch.zeros(1, 4)
+    ts = w.pull(keys, vals, asynchronous=True)
+    if ts != LOCAL:
+        w.wait(ts)
+    w.waitall()
+
+
+def test_validation_errors(server):
+    w = adapm.Worker(0, server)
+    with pytest.raises(IndexError, match="outside the key range"):
+        w.pull(torch.tensor([99], dtype=torch.int64), torch.zeros(1, 4))
+    with pytest.raises(ValueError, match="does not match the size"):
+        w.pull(torch.tensor([1], dtype=torch.int64), torch.zeros(1, 3))
+
+
+def test_intent_and_clock(server):
+    w = adapm.Worker(0, server)
+    w.intent(torch.tensor([5], dtype=torch.int64), 0, 10)
+    assert w.advance_clock() == 1
+    assert w.current_clock == 1
+    w.wait_sync()
+
+
+def test_sampling_uniform(server):
+    server.enable_sampling_support("naive", True, "uniform", 0, 50)
+    w = adapm.Worker(0, server)
+    h = w.prepare_sample(8, 0)
+    keys = np.zeros(8, dtype=np.int64)
+    vals = np.zeros((8, 4), dtype=np.float32)
+    w.pull_sample(h, keys, vals)
+    assert keys.min() >= 0 and keys.max() < 50
+
+
+def test_sampling_log_uniform(server):
+    server.enable_sampling_support("naive", True, "log-uniform", 0, 50)
+    w = adapm.Worker(0, server)
+    h = w.prepare_sample(64, 0)
+    keys = np.zeros(64, dtype=np.int64)
+    vals = np.zeros((64, 4), dtype=np.float32)
+    w.pull_sample(h, keys, vals)
+    assert keys.min() >= 0 and keys.max() < 50
+    # log-uniform skews toward small keys
+    assert np.median(keys) < 25
+
+
+def test_misc_api(server):
+    w = adapm.Worker(0, server)
+    assert w.num_keys == 50
+    assert w.get_key_size(3) == 4
+    w.begin_setup()
+    w.end_setup()
+    w.barrier()
+    assert server.my_rank() == 0
+    adapm.scheduler(50, 2)  # no-op, must not raise
+
+
+def test_per_key_value_lengths():
+    adapm.setup(10, 1)
+    lens = torch.tensor([2] * 5 + [6] * 5, dtype=torch.int64)
+    s = adapm.Server(lens)
+    w = adapm.Worker(0, s)
+    keys = torch.tensor([0, 7], dtype=torch.int64)
+    vals = torch.zeros(8)  # 2 + 6 flat
+    w.set(keys, torch.arange(8.0))
+    got = torch.zeros(8)
+    w.pull(keys, got)
+    assert torch.allclose(got, torch.arange(8.0))
+    assert w.get_key_size(0) == 2 and w.get_key_size(7) == 6
+    s.shutdown()
+
+
+def test_example_runs():
+    """The bundled example (reference bindings/example.py analog)."""
+    import examples.bindings_example as ex
+    ex.main()

@@ -112,13 +112,17 @@ class Server:
 
         self.sampling = None  # set by enable_sampling_support
 
+        # native host-routing core (C++ via ctypes; None -> numpy fallback)
+        from ..native import get_lib
+        self._native = get_lib()
+
         # observability (reference PS_TRACE_KEYS / PS_LOCALITY_STATS, §5)
         from ..utils.stats import (KeyTracer, LocalityStats, ALLOC,
                                    parse_trace_spec)
         traced = parse_trace_spec(self.opts.trace_keys or "", self.num_keys)
         self.tracer = KeyTracer(traced, self.num_keys) \
             if traced is not None else None
-        self.locality = LocalityStats(self.num_keys) \
+        self.locality = LocalityStats(self.num_keys, self._native) \
             if self.opts.locality_stats else None
         if self.tracer is not None:
             # initial allocation events, grouped by home shard (one record
@@ -182,8 +186,22 @@ class Server:
         replica shard+slot (OOB where none), replica mask, remote-key count.
         Locality stats are recorded here (the one place all data-plane ops
         pass through); `write_through` marks ops that must reach the owner
-        regardless of replicas (Set), so a replica doesn't count as local."""
+        regardless of replicas (Set), so a replica doesn't count as local.
+        Uses the native router (adapm_tpu/native) when available."""
         ab = self.ab
+        if self._native is not None:
+            from ..native import route
+            flat = np.ascontiguousarray(keys.ravel(), dtype=np.int64)
+            o_sh, o_sl, c_sh, c_sl, use_c, n_remote, local = route(
+                self._native, flat, ab.owner, ab.slot,
+                ab.cache_slot[shard], shard, int(OOB), write_through)
+            if self.locality is not None:
+                self.locality.record(flat, local)
+            sh = keys.shape
+            o_sh, o_sl = o_sh.reshape(sh), o_sl.reshape(sh)
+            c_sh, c_sl = c_sh.reshape(sh), c_sl.reshape(sh)
+            use_c = use_c.reshape(sh)
+            return o_sh, o_sl, c_sh, c_sl, use_c, n_remote
         o_sh = ab.owner[keys].astype(np.int32)
         o_sl = ab.slot[keys].astype(np.int32)
         cs = ab.cache_slot[shard, keys].astype(np.int32)

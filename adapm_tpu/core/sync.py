@@ -109,7 +109,12 @@ class SyncManager:
                   relocations, replications) -> None:
         ab = self.server.ab
         ie = self.intent_end
-        np.maximum.at(ie[shard], keys, end)
+        if self.server._native is not None:
+            self.server._native.adapm_intent_max(
+                np.ascontiguousarray(keys, np.int64), len(keys), int(end),
+                ie[shard])
+        else:
+            np.maximum.at(ie[shard], keys, end)
         if self.server.tracer is not None:
             from ..utils.stats import INTENT_START
             self.server.tracer.record(keys, INTENT_START, shard)
@@ -169,13 +174,22 @@ class SyncManager:
         if not reps:
             return
         min_clocks = self.server.shard_min_clocks()
-        keep: List[Tuple[int, int]] = []
-        drop: List[Tuple[int, int]] = []
-        for (k, s) in reps:
-            if self.intent_end[s, k] >= min_clocks[s]:
-                keep.append((k, s))
-            else:
-                drop.append((k, s))
+        items = list(reps)
+        if self.server._native is not None:
+            karr = np.fromiter((k for k, _ in items), np.int64, len(items))
+            sarr = np.fromiter((s for _, s in items), np.int32, len(items))
+            keep_mask = np.empty(len(items), np.uint8)
+            self.server._native.adapm_replica_scan(
+                karr, sarr, len(items), self.intent_end.ravel(),
+                np.ascontiguousarray(min_clocks, np.int64),
+                self.server.num_keys, keep_mask)
+            keep = [it for it, m in zip(items, keep_mask) if m]
+            drop = [it for it, m in zip(items, keep_mask) if not m]
+        else:
+            keep = [(k, s) for k, s in items
+                    if self.intent_end[s, k] >= min_clocks[s]]
+            drop = [(k, s) for k, s in items
+                    if self.intent_end[s, k] < min_clocks[s]]
         if keep:
             self.server._sync_replicas(keep)
             self.stats.keys_synced += len(keep)

@@ -95,12 +95,19 @@ class LocalityStats:
     """Per-key access counters: how many pulls/pushes, how many of those
     were served locally (owner or replica on the accessing shard)."""
 
-    def __init__(self, num_keys: int):
+    def __init__(self, num_keys: int, native_lib=None):
         self.accesses = np.zeros(num_keys, dtype=np.int64)
         self.local = np.zeros(num_keys, dtype=np.int64)
         self.sampling_accesses = np.zeros(num_keys, dtype=np.int64)
+        self._native = native_lib
 
     def record(self, keys: np.ndarray, local_mask: np.ndarray) -> None:
+        if self._native is not None:
+            self._native.adapm_count(
+                np.ascontiguousarray(keys, np.int64),
+                np.ascontiguousarray(local_mask, np.uint8), len(keys),
+                self.accesses, self.local)
+            return
         np.add.at(self.accesses, keys, 1)
         np.add.at(self.local, keys, local_mask.astype(np.int64))
 

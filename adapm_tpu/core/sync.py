@@ -110,9 +110,11 @@ class SyncManager:
         ab = self.server.ab
         ie = self.intent_end
         if self.server._native is not None:
-            self.server._native.adapm_intent_max(
-                np.ascontiguousarray(keys, np.int64), len(keys), int(end),
-                ie[shard])
+            bad = self.server._native.adapm_intent_max(
+                np.ascontiguousarray(keys, np.int64), len(keys),
+                self.server.num_keys, int(end), ie[shard])
+            if bad:
+                raise IndexError(f"{bad} intent keys outside the key range")
         else:
             np.maximum.at(ie[shard], keys, end)
         if self.server.tracer is not None:

@@ -98,14 +98,16 @@ def get_lib() -> Optional[ctypes.CDLL]:
         u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
         lib.adapm_route.restype = ctypes.c_int64
         lib.adapm_route.argtypes = [
-            i64p, ctypes.c_int64, i32p, i32p, i32p, ctypes.c_int32,
-            ctypes.c_int32, ctypes.c_int32, i32p, i32p, i32p, i32p, u8p,
-            u8p]
-        lib.adapm_count.restype = None
-        lib.adapm_count.argtypes = [i64p, u8p, ctypes.c_int64, i64p, i64p]
-        lib.adapm_intent_max.restype = None
+            i64p, ctypes.c_int64, ctypes.c_int64, i32p, i32p, i32p,
+            ctypes.c_int32, ctypes.c_int32, ctypes.c_int32, i32p, i32p,
+            i32p, i32p, u8p, u8p]
+        lib.adapm_count.restype = ctypes.c_int64
+        lib.adapm_count.argtypes = [i64p, u8p, ctypes.c_int64,
+                                    ctypes.c_int64, i64p, i64p]
+        lib.adapm_intent_max.restype = ctypes.c_int64
         lib.adapm_intent_max.argtypes = [i64p, ctypes.c_int64,
-                                         ctypes.c_int64, i64p]
+                                         ctypes.c_int64, ctypes.c_int64,
+                                         i64p]
         lib.adapm_replica_scan.restype = ctypes.c_int64
         lib.adapm_replica_scan.argtypes = [
             i64p, i32p, ctypes.c_int64, i64p, i64p, ctypes.c_int64, u8p]
@@ -119,14 +121,19 @@ def route(lib, keys: np.ndarray, owner: np.ndarray, slot: np.ndarray,
     """ctypes wrapper for adapm_route; returns Server._route's tuple layout
     plus the per-key local mask (for locality stats)."""
     n = len(keys)
+    num_keys = len(owner)
     o_sh = np.empty(n, np.int32)
     o_sl = np.empty(n, np.int32)
     c_sh = np.empty(n, np.int32)
     c_sl = np.empty(n, np.int32)
     use_c = np.empty(n, np.uint8)
     local = np.empty(n, np.uint8)
+    keys = np.ascontiguousarray(keys, np.int64)
     n_remote = lib.adapm_route(
-        np.ascontiguousarray(keys, np.int64), n, owner, slot,
-        cache_slot_row, shard, oob, int(write_through),
-        o_sh, o_sl, c_sh, c_sl, use_c, local)
+        keys, n, num_keys, owner, slot, cache_slot_row, shard, oob,
+        int(write_through), o_sh, o_sl, c_sh, c_sl, use_c, local)
+    if n_remote < 0:
+        bad = keys[-(n_remote + 1)]
+        raise IndexError(
+            f"key {bad} is outside the key range [0, {num_keys})")
     return o_sh, o_sl, c_sh, c_sl, use_c.astype(bool), int(n_remote), local

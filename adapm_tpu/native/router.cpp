@@ -25,7 +25,10 @@ extern "C" {
 // c_sh/c_sl (replica coordinates; c_sl=oob where none), use_c mask.
 // Returns the number of remote keys (not owned here, no local replica;
 // write_through: replicas don't count as local).
-int64_t adapm_route(const int64_t* keys, int64_t n,
+// Returns the remote-key count, or -(i+1) if keys[i] is the first key
+// outside [0, num_keys) (the caller raises; the numpy fallback would have
+// raised IndexError, and unchecked table reads here would corrupt memory).
+int64_t adapm_route(const int64_t* keys, int64_t n, int64_t num_keys,
                     const int32_t* owner, const int32_t* slot,
                     const int32_t* cache_slot_row,  // cache_slot[shard, :]
                     int32_t shard, int32_t oob, int32_t write_through,
@@ -35,6 +38,7 @@ int64_t adapm_route(const int64_t* keys, int64_t n,
   int64_t n_remote = 0;
   for (int64_t i = 0; i < n; ++i) {
     const int64_t k = keys[i];
+    if (k < 0 || k >= num_keys) return -(i + 1);
     const int32_t ow = owner[k];
     const int32_t cs = cache_slot_row[k];
     const bool replica = cs >= 0;
@@ -53,22 +57,32 @@ int64_t adapm_route(const int64_t* keys, int64_t n,
 
 // Locality counters: accesses[k] += 1; local_acc[k] += local[i]
 // (the vectorized replacement for np.add.at, which is slow for large
-// batches of duplicate keys).
-void adapm_count(const int64_t* keys, const uint8_t* local, int64_t n,
-                 int64_t* accesses, int64_t* local_acc) {
+// batches of duplicate keys). Out-of-range keys are skipped; returns the
+// number skipped so the caller can raise.
+int64_t adapm_count(const int64_t* keys, const uint8_t* local, int64_t n,
+                    int64_t num_keys, int64_t* accesses,
+                    int64_t* local_acc) {
+  int64_t bad = 0;
   for (int64_t i = 0; i < n; ++i) {
-    accesses[keys[i]] += 1;
-    local_acc[keys[i]] += local[i];
+    const int64_t k = keys[i];
+    if (k < 0 || k >= num_keys) { ++bad; continue; }
+    accesses[k] += 1;
+    local_acc[k] += local[i];
   }
+  return bad;
 }
 
 // Intent bookkeeping: intent_end[k] = max(intent_end[k], end) for a key
-// batch (SyncManager._register's np.maximum.at).
-void adapm_intent_max(const int64_t* keys, int64_t n, int64_t end,
-                      int64_t* intent_end) {
+// batch (SyncManager._register's np.maximum.at). Returns skipped count.
+int64_t adapm_intent_max(const int64_t* keys, int64_t n, int64_t num_keys,
+                         int64_t end, int64_t* intent_end) {
+  int64_t bad = 0;
   for (int64_t i = 0; i < n; ++i) {
-    if (intent_end[keys[i]] < end) intent_end[keys[i]] = end;
+    const int64_t k = keys[i];
+    if (k < 0 || k >= num_keys) { ++bad; continue; }
+    if (intent_end[k] < end) intent_end[k] = end;
   }
+  return bad;
 }
 
 // Replica expiry scan (SyncManager.sync_channel's keep/drop partition):

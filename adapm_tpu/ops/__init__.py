@@ -1,3 +1,4 @@
 """Hot-path device programs: fused gather->grad->AdaGrad->scatter steps."""
-from .fused import (FusedStepRunner, Routes, build_routes,  # noqa
-                    make_fused_adagrad_step)
+from .fused import (DeviceRoutedRunner, DeviceRouter,  # noqa
+                    FusedStepRunner, Routes, build_routes,
+                    make_device_routed_step, make_fused_adagrad_step)

@@ -21,6 +21,23 @@ Routing (which shard/slot serves each key) is resolved on the host from the
 Addressbook — exactly what `Server._pull`/`_push` do — and handed to the
 program as index arrays, so relocation/replication decisions made by the
 planner between steps are transparently picked up.
+
+Two routing modes:
+  host routes (build_routes):  the host resolves every key and ships five
+      index arrays per role. Simple, but at bench scale the host pays
+      ~milliseconds per step in table lookups + host->device transfers
+      while the device step takes microseconds.
+  device routes (DeviceRouter): the Addressbook tables (owner, slot, the
+      worker shard's cache-slot row) are mirrored into HBM, re-uploaded
+      lazily when the planner changes placement (topology_version), and the
+      jitted step resolves routes itself — per step the host ships only raw
+      keys. This is the TPU-idiomatic shape: table lookups are trivial
+      device gathers, and placement changes are rare relative to steps.
+
+Negative sampling can also run on device (`sample_negs_on_device`): drawing
+uniform positions into a device mirror of the locally-resident key index is
+exactly the Local sampling scheme (core/sampling.py) executed in-program,
+eliminating the per-step sample key transfer too.
 """
 from __future__ import annotations
 
@@ -144,6 +161,237 @@ def make_fused_adagrad_step(
         return tuple(new_pools), loss
 
     return step
+
+
+class DeviceRouter:
+    """Device mirrors of the Addressbook tables for one worker shard,
+    refreshed lazily on placement changes (Server.topology_version)."""
+
+    def __init__(self, server, shard: int):
+        self.server = server
+        self.shard = shard
+        self._version = -1
+        self.owner = None      # [num_keys] int32
+        self.slot = None       # [num_keys] int32
+        self.cache_row = None  # [num_keys] int32 (this shard's replica slots)
+
+    def refresh(self):
+        srv = self.server
+        if self._version == srv.topology_version and self.owner is not None:
+            return
+        ab = srv.ab
+        self.owner = jnp.asarray(ab.owner)
+        self.slot = jnp.asarray(ab.slot)
+        self.cache_row = jnp.asarray(ab.cache_slot[self.shard])
+        self._version = srv.topology_version
+
+    def tables(self):
+        self.refresh()
+        return self.owner, self.slot, self.cache_row
+
+
+def _route_on_device(tables, keys, shard: int):
+    """In-jit route resolution: the device-side twin of Server._route
+    (and native adapm_route). keys int32/int64 device array."""
+    owner, slot, cache_row = tables
+    o_sh = owner[keys]
+    cs = cache_row[keys]
+    use_c = cs >= 0
+    g_sl = jnp.where(use_c, OOB, slot[keys])
+    c_sh = jnp.full_like(o_sh, shard)
+    c_sl = jnp.where(use_c, cs, OOB)
+    return (o_sh, g_sl, c_sh, c_sl, use_c)
+
+
+def make_device_routed_step(loss_fn: Callable[..., jnp.ndarray],
+                            role_class: Dict[str, int],
+                            role_dim: Dict[str, int],
+                            shard: int,
+                            frozen_roles: Sequence[str] = (),
+                            neg_role: str = None,
+                            neg_shape: Tuple[int, ...] = None,
+                            no_replicas: bool = False):
+    """Fused step that resolves routing in-program from device table
+    mirrors. Signature of the returned step:
+
+        step(pools, tables, keys, local_index, rng_key, aux, lr, eps)
+          pools       tuple per class of (main, cache, delta)  [donated]
+          tables      (owner, slot, cache_row) device mirrors — key-indexed
+                      global arrays, shared by all length classes
+          keys        dict role -> device int array (raw PM keys)
+          local_index [L] int32 device array of locally-resident keys for
+                      on-device negative sampling (None disables)
+          rng_key     jax PRNG key for the device-side sampler
+
+    When `neg_role` is set and local_index is non-empty, that role's keys
+    are DRAWN in-program: uniform positions into local_index — the Local
+    sampling scheme (core/sampling.py LocalSampling) executed on device.
+
+    `no_replicas=True` compiles the replica-free specialization: reads touch
+    only the main pool (1/3 of the gather traffic) and updates scatter only
+    into main. Legal exactly while this shard holds zero replicas — the
+    runner re-checks per step and switches variants (HBM bandwidth is the
+    roofline for embedding workloads, so this is a large win whenever the
+    planner hasn't replicated anything here).
+    """
+    roles = sorted(role_class)
+    trainable = [r for r in roles if r not in frozen_roles]
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def step(pools, tables, keys, local_index, rng_key, aux, lr, eps):
+        keys = dict(keys)
+        if neg_role is not None and local_index is not None:
+            idx, count = local_index  # padded index + valid count
+            pos = jax.random.randint(rng_key, neg_shape, 0, count)
+            keys[neg_role] = idx[pos]
+        rows = {}
+        routes = {}
+        for r in roles:
+            cid = role_class[r]
+            main, cache, delta = pools[cid]
+            if no_replicas:
+                owner, slot, _ = tables
+                o_sh, o_sl = owner[keys[r]], slot[keys[r]]
+                routes[r] = (o_sh, o_sl)
+                rows[r] = main.at[o_sh, o_sl].get(mode="fill", fill_value=0)
+                continue
+            routes[r] = _route_on_device(tables, keys[r], shard)
+            rows[r] = _read_rows(main, cache, delta, routes[r])
+        embs = {r: rows[r][..., : role_dim[r]] for r in roles}
+        accs = {r: rows[r][..., role_dim[r]:] for r in roles}
+
+        def objective(train_embs):
+            merged = dict(embs)
+            merged.update(train_embs)
+            return loss_fn(merged, aux)
+
+        loss, grads = jax.value_and_grad(objective)(
+            {r: embs[r] for r in trainable})
+
+        new_pools = list(pools)
+        for r in trainable:
+            g = grads[r]
+            g2 = g * g
+            upd_emb = -lr * g * jax.lax.rsqrt(accs[r] + g2 + eps)
+            upd = jnp.concatenate([upd_emb, g2], axis=-1)
+            cid = role_class[r]
+            main, cache, delta = new_pools[cid]
+            if no_replicas:
+                o_sh, o_sl = routes[r]
+                main = main.at[o_sh, o_sl].add(upd, mode="drop")
+            else:
+                main, delta = _scatter_update(main, delta, routes[r], upd)
+            new_pools[cid] = (main, cache, delta)
+        return tuple(new_pools), loss
+
+    return step
+
+
+class DeviceRoutedRunner:
+    """FusedStepRunner's fast sibling: routing (and optionally negative
+    sampling) happens on device. Per step the host ships only the raw key
+    batch; table mirrors refresh lazily when the planner moves parameters.
+
+    Locality statistics are not recorded on this path (routing never
+    returns to the host); use FusedStepRunner when auditing locality.
+    """
+
+    def __init__(self, server, loss_fn, role_class: Dict[str, int],
+                 role_dim: Dict[str, int], shard: int = 0,
+                 frozen_roles: Sequence[str] = (), neg_role: str = None,
+                 neg_shape: Tuple[int, ...] = None,
+                 neg_population=None, seed: int = 0):
+        self.server = server
+        self.shard = shard
+        self.role_class = role_class
+        self.router = DeviceRouter(server, shard)
+        self.neg_role = neg_role
+        self._rng = jax.random.PRNGKey(seed)
+        # population the device sampler may draw from (Local scheme: the
+        # locally-resident slice of the allowed keys); None -> all keys
+        self._neg_population = None if neg_population is None else \
+            np.unique(np.asarray(neg_population, dtype=np.int64))
+        if self._neg_population is not None and neg_role is not None:
+            kc = server.ab.key_class[self._neg_population]
+            assert (kc == role_class[neg_role]).all(), (
+                "neg_population spans length classes "
+                f"{np.unique(kc)} but role {neg_role} is class "
+                f"{role_class[neg_role]}")
+        self._local_index = None
+        self._li_version = -1
+        mk = lambda nr: make_device_routed_step(  # noqa: E731
+            loss_fn, role_class, role_dim, shard, frozen_roles,
+            neg_role=neg_role, neg_shape=neg_shape, no_replicas=nr)
+        self.step_fn = mk(False)
+        # replica-free specialization: 1/3 the gather traffic; selected per
+        # step while this shard holds no replicas
+        self._step_fn_norep = mk(True)
+        self._rep_version = -1
+        self._has_replicas = True
+        self.steps = 0
+
+    def _shard_has_replicas(self) -> bool:
+        srv = self.server
+        if self._rep_version != srv.topology_version:
+            self._has_replicas = bool(
+                (srv.ab.cache_slot[self.shard] >= 0).any())
+            self._rep_version = srv.topology_version
+        return self._has_replicas
+
+    def _local_neg_index(self):
+        """(padded index [capacity], valid count) — padded to a power-of-two
+        capacity so placement changes don't change the jit shape (only a
+        capacity doubling recompiles)."""
+        srv = self.server
+        if self._li_version == srv.topology_version and \
+                self._local_index is not None:
+            return self._local_index
+        ab = srv.ab
+        pop = self._neg_population if self._neg_population is not None \
+            else np.arange(srv.num_keys, dtype=np.int64)
+        from ..base import NO_SLOT
+        from ..core.store import bucket_size
+        local = (ab.owner[pop] == self.shard) | (
+            ab.cache_slot[self.shard, pop] != NO_SLOT)
+        idx = pop[local]
+        if len(idx) == 0:
+            idx = pop  # nothing local: draw from the full population
+        cap = bucket_size(len(idx), minimum=64)
+        padded = np.zeros(cap, dtype=np.int32)
+        padded[: len(idx)] = idx
+        self._local_index = (jnp.asarray(padded),
+                             jnp.int32(len(idx)))
+        self._li_version = srv.topology_version
+        return self._local_index
+
+    def __call__(self, role_keys: Dict[str, np.ndarray], aux, lr: float,
+                 eps: float = 1e-10) -> jnp.ndarray:
+        srv = self.server
+        for r, k in role_keys.items():
+            # fail fast on a wrong role->class mapping: per-class slot
+            # indices gathered for the wrong pool would corrupt rows
+            # (same check as build_routes)
+            kc = srv.ab.key_class[np.asarray(k, dtype=np.int64)]
+            assert (kc == self.role_class[r]).all(), (
+                f"role {r}: keys span length classes {np.unique(kc)} but "
+                f"role is mapped to class {self.role_class[r]}")
+        with srv._lock:
+            tables = self.router.tables()
+            local_index = self._local_neg_index() \
+                if self.neg_role is not None else None
+            self._rng, sub = jax.random.split(self._rng)
+            keys = {r: jnp.asarray(np.asarray(k, dtype=np.int32))
+                    for r, k in role_keys.items()}
+            pools = tuple((s.main, s.cache, s.delta) for s in srv.stores)
+            fn = self.step_fn if self._shard_has_replicas() \
+                else self._step_fn_norep
+            pools, loss = fn(
+                pools, tables, keys, local_index, sub, aux,
+                jnp.float32(lr), jnp.float32(eps))
+            for st, (m, c, d) in zip(srv.stores, pools):
+                st.main, st.cache, st.delta = m, c, d
+        self.steps += 1
+        return loss
 
 
 class FusedStepRunner:

@@ -103,10 +103,12 @@ class LocalityStats:
 
     def record(self, keys: np.ndarray, local_mask: np.ndarray) -> None:
         if self._native is not None:
-            self._native.adapm_count(
+            bad = self._native.adapm_count(
                 np.ascontiguousarray(keys, np.int64),
                 np.ascontiguousarray(local_mask, np.uint8), len(keys),
-                self.accesses, self.local)
+                len(self.accesses), self.accesses, self.local)
+            if bad:
+                raise IndexError(f"{bad} stat keys outside the key range")
             return
         np.add.at(self.accesses, keys, 1)
         np.add.at(self.local, keys, local_mask.astype(np.int64))

@@ -58,16 +58,31 @@ def test_count(lib):
     loc = np.zeros(16, dtype=np.int64)
     keys = np.array([3, 3, 5, 3], dtype=np.int64)
     mask = np.array([1, 0, 1, 1], dtype=np.uint8)
-    lib.adapm_count(keys, mask, 4, acc, loc)
+    assert lib.adapm_count(keys, mask, 4, 16, acc, loc) == 0
     assert acc[3] == 3 and acc[5] == 1
     assert loc[3] == 2 and loc[5] == 1
+    # out-of-range keys are skipped and reported
+    assert lib.adapm_count(np.array([99], dtype=np.int64),
+                           np.array([1], dtype=np.uint8), 1, 16,
+                           acc, loc) == 1
 
 
 def test_intent_max(lib):
     ie = np.full(8, -1, dtype=np.int64)
-    lib.adapm_intent_max(np.array([1, 2, 1], dtype=np.int64), 3, 10, ie)
-    lib.adapm_intent_max(np.array([1], dtype=np.int64), 1, 5, ie)
+    assert lib.adapm_intent_max(np.array([1, 2, 1], dtype=np.int64),
+                                3, 8, 10, ie) == 0
+    assert lib.adapm_intent_max(np.array([1], dtype=np.int64),
+                                1, 8, 5, ie) == 0
     assert ie[1] == 10 and ie[2] == 10 and ie[0] == -1
+
+
+def test_route_bounds(lib):
+    rng = np.random.default_rng(3)
+    owner, slot, cache = _tables(rng)
+    from adapm_tpu import native as n
+    with pytest.raises(IndexError, match="outside the key range"):
+        n.route(lib, np.array([99], dtype=np.int64), owner, slot,
+                cache[0], 0, int(OOB), False)
 
 
 def test_replica_scan(lib):

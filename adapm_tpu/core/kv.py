@@ -202,6 +202,13 @@ class Server:
             c_sh, c_sl = c_sh.reshape(sh), c_sl.reshape(sh)
             use_c = use_c.reshape(sh)
             return o_sh, o_sl, c_sh, c_sl, use_c, n_remote
+        # numpy fallback: match the native path's bounds behavior (negative
+        # keys would otherwise silently wrap to the last keys)
+        if len(keys) and (int(keys.min()) < 0
+                          or int(keys.max()) >= self.num_keys):
+            bad = keys[(keys < 0) | (keys >= self.num_keys)].ravel()[0]
+            raise IndexError(
+                f"key {bad} is outside the key range [0, {self.num_keys})")
         o_sh = ab.owner[keys].astype(np.int32)
         o_sl = ab.slot[keys].astype(np.int32)
         cs = ab.cache_slot[shard, keys].astype(np.int32)
@@ -632,6 +639,23 @@ class Worker:
             self.stats["push_ops_local"] += 1
             return LOCAL
         return self._new_ts(_WaitEntry(is_write=True))
+
+    def staggered_push(self, keys, vals, group_size: int = 100_000) -> int:
+        """Push a large key set in groups (reference StaggeredPush,
+        coloc_kv_worker.h:556-580: bounds per-request buffering when
+        pushing e.g. a whole initial model). Returns the last group's ts."""
+        keys = self._keys(keys)
+        vals = np.asarray(vals, dtype=np.float32)
+        flat = vals.ndim == 1
+        if flat:
+            cum = np.zeros(len(keys) + 1, dtype=np.int64)
+            np.cumsum(self.server.value_lengths[keys], out=cum[1:])
+        ts = LOCAL
+        for lo in range(0, len(keys), group_size):
+            hi = min(lo + group_size, len(keys))
+            part = vals[cum[lo]:cum[hi]] if flat else vals[lo:hi]
+            ts = self.push(keys[lo:hi], part)
+        return ts
 
     def set(self, keys, vals) -> int:
         """Overwrite values (reference Set: non-additive write)."""

@@ -34,9 +34,10 @@ Two routing modes:
       keys. This is the TPU-idiomatic shape: table lookups are trivial
       device gathers, and placement changes are rare relative to steps.
 
-Negative sampling can also run on device (`sample_negs_on_device`): drawing
-uniform positions into a device mirror of the locally-resident key index is
-exactly the Local sampling scheme (core/sampling.py) executed in-program,
+Negative sampling can also run on device (the `neg_role`/`neg_shape`
+parameters of DeviceRoutedRunner / make_device_routed_step): drawing uniform
+positions into a device mirror of the locally-resident key index is exactly
+the Local sampling scheme (core/sampling.py) executed in-program,
 eliminating the per-step sample key transfer too.
 """
 from __future__ import annotations
@@ -367,6 +368,11 @@ class DeviceRoutedRunner:
     def __call__(self, role_keys: Dict[str, np.ndarray], aux, lr: float,
                  eps: float = 1e-10) -> jnp.ndarray:
         srv = self.server
+        if self.neg_role is not None and self.neg_role in role_keys:
+            raise ValueError(
+                f"role {self.neg_role!r} is sampled on device; caller-"
+                "supplied keys for it would be silently discarded — drop "
+                "them or build the runner without neg_role")
         for r, k in role_keys.items():
             # fail fast on a wrong role->class mapping: per-class slot
             # indices gathered for the wrong pool would corrupt rows
@@ -380,7 +386,10 @@ class DeviceRoutedRunner:
             local_index = self._local_neg_index() \
                 if self.neg_role is not None else None
             self._rng, sub = jax.random.split(self._rng)
-            keys = {r: jnp.asarray(np.asarray(k, dtype=np.int32))
+            # int32 keys halve the upload; validated above to be < num_keys,
+            # so int32 is exact unless the key space itself exceeds 2^31
+            kdtype = np.int32 if srv.num_keys <= 2**31 else np.int64
+            keys = {r: jnp.asarray(np.asarray(k, dtype=kdtype))
                     for r, k in role_keys.items()}
             pools = tuple((s.main, s.cache, s.delta) for s in srv.stores)
             fn = self.step_fn if self._shard_has_replicas() \

@@ -62,19 +62,28 @@ def bench_tpu(E=200_000, R=1_000, d=128, B=4096, N=32, steps=50,
             "o": rng.integers(0, E, B).astype(np.int64),
         }
 
-    for _ in range(warmup):
-        runner(batch(), None, 0.1)
-    srv.block()
+    # Slope timing: some remote-attached TPU runtimes acknowledge
+    # block_until_ready before work completes; only a value fetch truly
+    # syncs, at a large fixed RTT. Timing two loop lengths and taking the
+    # slope removes both the RTT and any warmup from the estimate.
+    batches = [batch() for _ in range(4)]
 
-    t0 = time.perf_counter()
-    loss = 0.0
-    for _ in range(steps):
-        loss = runner(batch(), None, 0.1)
-    jax.block_until_ready(loss)
-    srv.block()
-    dt = time.perf_counter() - t0
+    def timed(n: int) -> float:
+        t0 = time.perf_counter()
+        loss = None
+        for i in range(n):
+            loss = runner(batches[i % len(batches)], None, 0.1)
+        float(loss)  # force completion of the whole donated chain
+        return time.perf_counter() - t0
+
+    for _ in range(warmup):
+        runner(batches[0], None, 0.1)
+    timed(1)
+    t_short = timed(steps // 4)
+    t_long = timed(steps)
+    dt = (t_long - t_short) / (steps - steps // 4)
     srv.shutdown()
-    return B * steps / dt
+    return B / dt
 
 
 def bench_cpu_reference_proxy(E=20_000, R=100, d=128, N=32,

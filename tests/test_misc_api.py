@@ -1,0 +1,38 @@
+"""API-parity odds and ends (reference ColoKVWorker surface)."""
+import numpy as np
+
+import adapm_tpu
+from adapm_tpu.config import SystemOptions
+
+
+def test_staggered_push():
+    """StaggeredPush (coloc_kv_worker.h:556-580): grouped pushes over a
+    large key set, flat and 2-D value layouts."""
+    srv = adapm_tpu.setup(40, 4, opts=SystemOptions(sync_max_per_sec=0))
+    w = srv.make_worker(0)
+    keys = np.arange(40)
+    vals = np.ones((40, 4), np.float32)
+    w.staggered_push(keys, vals, group_size=7)
+    w.wait_all()
+    got = w.pull_sync(keys)
+    assert np.allclose(got, 1.0)
+    # flat layout too
+    w.staggered_push(keys, np.ones(160, np.float32) * 2, group_size=11)
+    w.wait_all()
+    got = w.pull_sync(keys)
+    assert np.allclose(got, 3.0)
+    srv.shutdown()
+
+
+def test_pull_if_local():
+    srv = adapm_tpu.setup(16, 2, opts=SystemOptions(sync_max_per_sec=0))
+    w = srv.make_worker(0)
+    local_keys = np.array([k for k in range(16)
+                           if srv.ab.owner[k] == w.shard])
+    ok, vals = w.pull_if_local(local_keys)
+    assert ok and vals is not None
+    remote = np.array([k for k in range(16) if srv.ab.owner[k] != w.shard])
+    if len(remote):
+        ok, vals = w.pull_if_local(remote[:1])
+        assert not ok and vals is None
+    srv.shutdown()

@@ -24,6 +24,18 @@ NOT_CACHED = -2                       # location cache: no cached location
 NO_SLOT = -1                          # key has no slot in a pool
 
 
+def check_key_range(keys, num_keys: int, what: str = "key") -> None:
+    """Raise IndexError if any key is outside [0, num_keys). One shared
+    guard so every host path (routing, intents, stats, fused runners)
+    reports the same way — negative keys would otherwise silently wrap via
+    numpy indexing, and XLA clamps them on device."""
+    keys = np.asarray(keys)
+    if keys.size and (int(keys.min()) < 0 or int(keys.max()) >= num_keys):
+        bad = keys[(keys < 0) | (keys >= num_keys)].ravel()[0]
+        raise IndexError(
+            f"{what} {bad} is outside the key range [0, {num_keys})")
+
+
 class MgmtTechniques(enum.Enum):
     """Which adaptive management actions the planner may take.
 

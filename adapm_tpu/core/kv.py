@@ -202,13 +202,9 @@ class Server:
             c_sh, c_sl = c_sh.reshape(sh), c_sl.reshape(sh)
             use_c = use_c.reshape(sh)
             return o_sh, o_sl, c_sh, c_sl, use_c, n_remote
-        # numpy fallback: match the native path's bounds behavior (negative
-        # keys would otherwise silently wrap to the last keys)
-        if len(keys) and (int(keys.min()) < 0
-                          or int(keys.max()) >= self.num_keys):
-            bad = keys[(keys < 0) | (keys >= self.num_keys)].ravel()[0]
-            raise IndexError(
-                f"key {bad} is outside the key range [0, {self.num_keys})")
+        # numpy fallback: match the native path's bounds behavior
+        from ..base import check_key_range
+        check_key_range(keys, self.num_keys)
         o_sh = ab.owner[keys].astype(np.int32)
         o_sl = ab.slot[keys].astype(np.int32)
         cs = ab.cache_slot[shard, keys].astype(np.int32)

@@ -116,8 +116,8 @@ class SyncManager:
             if bad:
                 raise IndexError(f"{bad} intent keys outside the key range")
         else:
-            if len(keys) and int(np.min(keys)) < 0:  # match native behavior
-                raise IndexError("negative intent key")
+            from ..base import check_key_range
+            check_key_range(keys, self.server.num_keys, "intent key")
             np.maximum.at(ie[shard], keys, end)
         if self.server.tracer is not None:
             from ..utils.stats import INTENT_START

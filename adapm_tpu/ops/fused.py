@@ -373,11 +373,15 @@ class DeviceRoutedRunner:
                 f"role {self.neg_role!r} is sampled on device; caller-"
                 "supplied keys for it would be silently discarded — drop "
                 "them or build the runner without neg_role")
+        from ..base import check_key_range
         for r, k in role_keys.items():
-            # fail fast on a wrong role->class mapping: per-class slot
-            # indices gathered for the wrong pool would corrupt rows
-            # (same check as build_routes)
-            kc = srv.ab.key_class[np.asarray(k, dtype=np.int64)]
+            k64 = np.asarray(k, dtype=np.int64)
+            # on device, XLA clamps bad indices instead of raising — reject
+            # out-of-range keys here, then fail fast on a wrong role->class
+            # mapping (per-class slot indices gathered for the wrong pool
+            # would corrupt rows; same check as build_routes)
+            check_key_range(k64, srv.num_keys, f"role {r} key")
+            kc = srv.ab.key_class[k64]
             assert (kc == self.role_class[r]).all(), (
                 f"role {r}: keys span length classes {np.unique(kc)} but "
                 f"role is mapped to class {self.role_class[r]}")
@@ -386,8 +390,9 @@ class DeviceRoutedRunner:
             local_index = self._local_neg_index() \
                 if self.neg_role is not None else None
             self._rng, sub = jax.random.split(self._rng)
-            # int32 keys halve the upload; validated above to be < num_keys,
-            # so int32 is exact unless the key space itself exceeds 2^31
+            # int32 keys halve the upload; validated above to be inside
+            # [0, num_keys), so int32 is exact unless the key space itself
+            # exceeds 2^31
             kdtype = np.int32 if srv.num_keys <= 2**31 else np.int64
             keys = {r: jnp.asarray(np.asarray(k, dtype=kdtype))
                     for r, k in role_keys.items()}

@@ -50,8 +50,17 @@ def make_mesh(num_shards: Optional[int] = None,
               devices: Optional[Sequence[jax.Device]] = None) -> MeshContext:
     if devices is None:
         # ADAPM_PLATFORM forces a backend (tests use cpu + virtual devices
-        # even when a TPU plugin claimed the default platform)
+        # even when a TPU plugin claimed the default platform). Also make it
+        # the *default* backend when possible: remote-attached default
+        # backends add per-dispatch round trips even for arrays living on
+        # the forced platform's devices.
         platform = os.environ.get("ADAPM_PLATFORM")
+        if platform:
+            try:
+                jax.config.update("jax_platforms", platform)
+            except Exception:
+                pass  # backends already initialized differently: still
+                # usable via the explicit device list below
         devices = jax.devices(platform) if platform else jax.devices()
     if num_shards is None:
         num_shards = len(devices)

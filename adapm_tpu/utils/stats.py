@@ -110,9 +110,8 @@ class LocalityStats:
             if bad:
                 raise IndexError(f"{bad} stat keys outside the key range")
             return
-        keys = np.asarray(keys)
-        if len(keys) and int(keys.min()) < 0:  # match native behavior
-            raise IndexError("negative stat key")
+        from ..base import check_key_range
+        check_key_range(keys, len(self.accesses), "stat key")
         np.add.at(self.accesses, keys, 1)
         np.add.at(self.local, keys, local_mask.astype(np.int64))
 

@@ -66,6 +66,7 @@ def bench_tpu(E=200_000, R=1_000, d=128, B=4096, N=32, steps=50,
     # block_until_ready before work completes; only a value fetch truly
     # syncs, at a large fixed RTT. Timing two loop lengths and taking the
     # slope removes both the RTT and any warmup from the estimate.
+    assert steps >= 4, "slope timing needs steps >= 4 (two loop lengths)"
     batches = [batch() for _ in range(4)]
 
     def timed(n: int) -> float:

@@ -1,0 +1,12 @@
+#!/usr/bin/env bash
+# Re-run a flaky-prone test N times (reference tests/repeat.sh).
+# Usage: scripts/repeat.sh 20 tests/test_consistency.py::test_monotonic_pushes
+set -euo pipefail
+cd "$(dirname "$0")/.."
+N=${1:?usage: repeat.sh N <pytest target>}
+shift
+for i in $(seq 1 "$N"); do
+  echo "=== run $i/$N ==="
+  python -m pytest "$@" -q
+done
+echo "PASSED $N/$N"

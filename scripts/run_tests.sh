@@ -1,0 +1,12 @@
+#!/usr/bin/env bash
+# Test harness (reference tests/run_tests.sh). The reference loops its test
+# binaries over --sys.techniques / --sampling.scheme variants from the
+# shell; here those variants are pytest parameterizations inside the suite
+# (test_consistency.py: all/replication_only/relocation_only;
+# test_sampling.py: naive/preloc/pool/local x with/without replacement),
+# so one pytest run covers the same matrix.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+python -m pytest tests/ -q "$@"
+echo "ALL TESTS PASSED"

@@ -30,7 +30,7 @@ import numpy as np
 
 from ..io import kge as kgeio
 from ..models.kge import make_eval_scores, make_kge_loss
-from ..ops import FusedStepRunner
+from ..ops import DeviceRoutedRunner, FusedStepRunner
 from ..utils import Stopwatch, alog
 from .common import (KeyMapper, RuntimeGuard, add_common_arguments,
                      enforce_full_replication, epoch_report, make_server,
@@ -205,6 +205,24 @@ def run_app(args) -> dict:
         lambda n, r: run.ekey(r.integers(0, run.E, n)),
         allowed_keys=run.ekey(np.arange(run.E)))
 
+    # --device_routes: the production TPU hot path — routing tables and
+    # negative sampling (Local scheme) live on device; one runner per
+    # worker shard (docs/PERF.md: ~2.4x over host routing)
+    dev_runners = {}
+
+    def device_runner(shard: int) -> DeviceRoutedRunner:
+        if shard not in dev_runners:
+            dev_runners[shard] = DeviceRoutedRunner(
+                srv, make_kge_loss(args.model),
+                role_class={"s": run.ent_class, "r": run.rel_class,
+                            "o": run.ent_class, "neg": run.ent_class},
+                role_dim={"s": run.ent_dim, "r": run.rel_dim,
+                          "o": run.ent_dim, "neg": run.ent_dim},
+                shard=shard, neg_role="neg", neg_shape=(B, N),
+                neg_population=run.ekey(np.arange(run.E)),
+                seed=args.seed + shard)
+        return dev_runners[shard]
+
     train = ds.train
     parts = np.array_split(np.arange(len(train)), run.num_workers)
     rng = np.random.default_rng(args.seed)
@@ -228,7 +246,8 @@ def run_app(args) -> dict:
                      run.ekey(t[:, 2])]))
                 fut = w.current_clock + ahead
                 w.intent(ks, fut, fut + 1)
-                handles[bi] = w.prepare_sample(B * N, fut, fut + 1)
+                if not args.device_routes:
+                    handles[bi] = w.prepare_sample(B * N, fut, fut + 1)
 
             for bi in range(min(args.lookahead, len(batches))):
                 prepare(bi, ahead=bi)
@@ -236,13 +255,16 @@ def run_app(args) -> dict:
                 if bi + args.lookahead < len(batches):
                     prepare(bi + args.lookahead, ahead=args.lookahead)
                 t = train[idx]
-                neg = np.asarray(
-                    w.pull_sample_keys(handles[bi], B * N)).reshape(B, N)
-                w.finish_sample(handles.pop(bi))
-                loss = run.runner(
-                    {"s": run.ekey(t[:, 0]), "r": run.rkey(t[:, 1]),
-                     "o": run.ekey(t[:, 2]), "neg": neg},
-                    None, args.lr, shard=w.shard)
+                roles = {"s": run.ekey(t[:, 0]), "r": run.rkey(t[:, 1]),
+                         "o": run.ekey(t[:, 2])}
+                if args.device_routes:
+                    loss = device_runner(w.shard)(roles, None, args.lr)
+                else:
+                    neg = np.asarray(
+                        w.pull_sample_keys(handles[bi], B * N)).reshape(B, N)
+                    w.finish_sample(handles.pop(bi))
+                    roles["neg"] = neg
+                    loss = run.runner(roles, None, args.lr, shard=w.shard)
                 epoch_loss += float(loss)
                 nbatches += 1
                 for _ in range(args.sync_rounds_per_step):
@@ -306,6 +328,9 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--synthetic_triples", type=int, default=1500)
     parser.add_argument("--lookahead", type=int, default=4,
                         help="intent/sample batches ahead (kge.cc :1059)")
+    parser.add_argument("--device_routes", action="store_true",
+                        help="device-routed fused step + on-device "
+                             "negative sampling (TPU hot path)")
     parser.add_argument("--init_scheme", default="normal",
                         choices=["normal", "uniform"])
     parser.add_argument("--init_scale", type=float, default=0.1)

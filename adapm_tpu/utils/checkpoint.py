@@ -1,0 +1,109 @@
+"""Whole-manager checkpoint/restore.
+
+The reference checkpoints only at the application level (pull full model ->
+write; resume = push inside BeginSetup/EndSetup — kge.cc:327-401, SURVEY.md
+§5 "Checkpoint / resume"); its adaptive state (ownership, replicas) is lost
+on restart. Here the *entire* manager state is a handful of arrays, so a
+checkpoint captures it exactly: pools (main/cache/delta per length class),
+addressbook tables, registered intent horizons, and worker clocks. Restore
+rebuilds the free-list allocators and the sync manager's replica registry
+from the tables, so an adapted placement survives a restart.
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as np
+
+
+FORMAT_VERSION = 1
+
+
+def save_server(server, path: str) -> None:
+    """Write the full manager state to an .npz (single-controller view)."""
+    server.block()
+    with server._lock:
+        arrs: Dict[str, np.ndarray] = {
+            "format_version": np.int64(FORMAT_VERSION),
+            "num_keys": np.int64(server.num_keys),
+            "num_shards": np.int64(server.num_shards),
+            "value_lengths": server.value_lengths,
+            "owner": server.ab.owner,
+            "slot": server.ab.slot,
+            "cache_slot": server.ab.cache_slot,
+            "relocation_counter": server.ab.relocation_counter,
+            "intent_end": server.sync.intent_end,
+            "clocks": server._clocks,
+        }
+        for cid, st in enumerate(server.stores):
+            arrs[f"main_{cid}"] = np.asarray(st.main)
+            arrs[f"cache_{cid}"] = np.asarray(st.cache)
+            arrs[f"delta_{cid}"] = np.asarray(st.delta)
+    np.savez_compressed(path, **arrs)
+
+
+def restore_server(server, path: str) -> None:
+    """Restore state saved by save_server into a compatibly-constructed
+    Server (same num_keys, value_lengths, shard count, pool geometry)."""
+    import jax
+    ck = np.load(path)
+    assert int(ck["format_version"]) == FORMAT_VERSION
+    assert int(ck["num_keys"]) == server.num_keys, "key count mismatch"
+    assert int(ck["num_shards"]) == server.num_shards, "shard mismatch"
+    assert (ck["value_lengths"] == server.value_lengths).all(), \
+        "value-length layout mismatch"
+    with server._lock:
+        ab = server.ab
+        ab.owner[:] = ck["owner"]
+        ab.slot[:] = ck["slot"]
+        ab.cache_slot[:] = ck["cache_slot"]
+        ab.relocation_counter[:] = ck["relocation_counter"]
+        ab.replica_count[:] = (ab.cache_slot >= 0).sum(axis=0)
+        server.sync.intent_end[:] = ck["intent_end"]
+        server._clocks[:] = ck["clocks"]
+
+        # pools back onto the mesh with their original shardings
+        for cid, st in enumerate(server.stores):
+            sh = st.ctx.shard0()
+            for name in ("main", "cache", "delta"):
+                arr = ck[f"{name}_{cid}"]
+                cur = getattr(st, name)
+                assert arr.shape == cur.shape, (
+                    f"pool {name}_{cid} geometry mismatch: checkpoint "
+                    f"{arr.shape} vs server {cur.shape}")
+                setattr(st, name, jax.device_put(arr, sh))
+
+        # rebuild free lists from table occupancy
+        for cid in range(len(server.stores)):
+            class_keys = np.nonzero(ab.key_class == cid)[0]
+            _rebuild_alloc(ab.main_alloc[cid],
+                           ab.owner[class_keys], ab.slot[class_keys])
+            used_by_shard = [
+                ab.cache_slot[s, class_keys] for s in range(server.num_shards)]
+            _rebuild_cache_alloc(ab.cache_alloc[cid], used_by_shard)
+
+        # rebuild the sync manager's replica registry
+        from ..core.sync import key_channel
+        for reps in server.sync.replicas:
+            reps.clear()
+        shards, keys = np.nonzero(ab.cache_slot >= 0)
+        chans = key_channel(keys.astype(np.int64),
+                            server.sync.num_channels)
+        for k, s, c in zip(keys, shards, chans):
+            server.sync.replicas[int(c)].add((int(k), int(s)))
+        server.topology_version += 1
+    server.block()
+
+
+def _rebuild_alloc(alloc, owners: np.ndarray, slots: np.ndarray) -> None:
+    for s in range(alloc.num_shards):
+        used = set(int(x) for x in slots[owners == s])
+        alloc._free[s] = [i for i in range(alloc.slots_per_shard - 1, -1, -1)
+                          if i not in used]
+
+
+def _rebuild_cache_alloc(alloc, used_by_shard) -> None:
+    for s in range(alloc.num_shards):
+        used = set(int(x) for x in used_by_shard[s] if x >= 0)
+        alloc._free[s] = [i for i in range(alloc.slots_per_shard - 1, -1, -1)
+                          if i not in used]

@@ -95,6 +95,20 @@ def test_kge_app(model):
     assert result["mrr"] > 0.15, result
 
 
+def test_kge_device_routes():
+    """--device_routes: the TPU hot path (in-program routing + on-device
+    Local-scheme negative sampling) trains to the same quality."""
+    from adapm_tpu.apps import knowledge_graph_embeddings as kge
+    args = kge.build_parser().parse_args(
+        ["--dim", "8", "--neg_ratio", "2", "--synthetic_entities", "60",
+         "--synthetic_relations", "4", "--synthetic_triples", "400",
+         "--epochs", "4", "--batch_size", "32", "--lr", "0.2",
+         "--eval_every", "4", "--eval_triples", "60",
+         "--device_routes"] + FAST)
+    result = kge.run_app(args)
+    assert result["mrr"] > 0.12, result
+
+
 def test_kge_checkpoint_resume(tmp_path):
     """Checkpoint -> resume (reference kge.cc checkpointing :327-401)."""
     from adapm_tpu.apps import knowledge_graph_embeddings as kge

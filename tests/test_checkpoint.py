@@ -1,0 +1,81 @@
+"""Manager checkpoint/restore: exact state round-trip including adapted
+placement (replicas + relocations), which the reference loses on restart
+(its checkpointing is app-level only, SURVEY.md §5)."""
+import numpy as np
+
+import adapm_tpu
+from adapm_tpu.base import CLOCK_MAX, MgmtTechniques
+from adapm_tpu.config import SystemOptions
+from adapm_tpu.utils.checkpoint import restore_server, save_server
+
+
+def _adapted_server():
+    opts = SystemOptions(sync_max_per_sec=0, cache_slots_per_shard=16)
+    srv = adapm_tpu.setup(32, 4, opts=opts)
+    w0, w1 = srv.make_worker(0), srv.make_worker(1)
+    rng = np.random.default_rng(0)
+    w0.set(np.arange(32), rng.normal(size=(32, 4)).astype(np.float32))
+    # competing intents -> replicas; exclusive intent -> relocation
+    shared = np.array([5, 9, 13])
+    w0.intent(shared, 0, CLOCK_MAX)
+    w1.intent(shared, 0, CLOCK_MAX)
+    own = np.array([k for k in range(32)
+                    if srv.ab.owner[k] not in (0,)][:2])
+    w0.intent(own, 0, CLOCK_MAX)
+    srv.wait_sync()
+    # pending replica deltas too
+    w0.push(shared, np.ones((3, 4), np.float32))
+    srv.block()
+    return srv, (w0, w1)
+
+
+def test_roundtrip_exact(tmp_path):
+    srv, (w0, w1) = _adapted_server()
+    path = str(tmp_path / "ck.npz")
+    save_server(srv, path)
+    before_main = srv.read_main(np.arange(32))
+    before_owner = srv.ab.owner.copy()
+    before_cache = srv.ab.cache_slot.copy()
+    srv.shutdown()
+
+    # fresh server, same geometry
+    srv2 = adapm_tpu.setup(
+        32, 4, opts=SystemOptions(sync_max_per_sec=0,
+                                  cache_slots_per_shard=16))
+    w0b = srv2.make_worker(0)
+    w1b = srv2.make_worker(1)
+    restore_server(srv2, path)
+
+    assert (srv2.ab.owner == before_owner).all()
+    assert (srv2.ab.cache_slot == before_cache).all()
+    assert np.allclose(srv2.read_main(np.arange(32)), before_main)
+    # replica reads include the restored pending delta
+    got = w0b.pull_sync(np.array([5]))
+    assert np.isfinite(got).all()
+
+    # the restored manager keeps working: quiesce flushes restored deltas
+    srv2.quiesce()
+    after = srv2.read_main(np.array([5, 9, 13]))
+    assert np.isfinite(after).all()
+    # allocators were rebuilt: new replicas/relocations still possible
+    free_keys = np.array([k for k in range(32)
+                          if srv2.ab.owner[k] != 0][:2])
+    w0b.intent(free_keys, w0b.current_clock, CLOCK_MAX)
+    w1b.intent(free_keys, w1b.current_clock, CLOCK_MAX)
+    srv2.wait_sync()
+    srv2.shutdown()
+
+
+def test_restore_rejects_mismatch(tmp_path):
+    srv, _ = _adapted_server()
+    path = str(tmp_path / "ck.npz")
+    save_server(srv, path)
+    srv.shutdown()
+    other = adapm_tpu.setup(16, 4,
+                            opts=SystemOptions(sync_max_per_sec=0))
+    try:
+        restore_server(other, path)
+        raise RuntimeError("should have failed")
+    except AssertionError as e:
+        assert "mismatch" in str(e)
+    other.shutdown()

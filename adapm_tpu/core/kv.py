@@ -486,9 +486,10 @@ class Server:
             alog("[stats]", self.sync.report())
         if not self.opts.stats_out:
             return []
+        from ..parallel import control
         from ..utils.stats import write_stats
-        return write_stats(self.opts.stats_out, 0, self.tracer,
-                           self.locality)
+        return write_stats(self.opts.stats_out, control.process_id(),
+                           self.tracer, self.locality)
 
     def wait_sync(self) -> None:
         """Act on all signalled intents and complete a full sync round
@@ -546,7 +547,10 @@ class Worker:
         self.server = server
         self.worker_id = worker_id
         self.shard = worker_id % server.num_shards
-        self._clock = 0
+        # seed from the server's clock table so a worker registered after a
+        # checkpoint restore resumes at the restored clock instead of
+        # regressing it to 0 on its first advance
+        self._clock = int(server._clocks[worker_id])
         self._ts = 0
         self._pending: Dict[int, _WaitEntry] = {}
         from .intent import IntentQueue

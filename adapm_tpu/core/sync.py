@@ -109,15 +109,16 @@ class SyncManager:
                   relocations, replications) -> None:
         ab = self.server.ab
         ie = self.intent_end
+        # validate up front so the native and numpy paths leave identical
+        # intent_end state when the batch contains a bad key (the C helper
+        # applies in-range updates before reporting the bad count)
+        from ..base import check_key_range
+        check_key_range(keys, self.server.num_keys, "intent key")
         if self.server._native is not None:
-            bad = self.server._native.adapm_intent_max(
+            self.server._native.adapm_intent_max(
                 np.ascontiguousarray(keys, np.int64), len(keys),
                 self.server.num_keys, int(end), ie[shard])
-            if bad:
-                raise IndexError(f"{bad} intent keys outside the key range")
         else:
-            from ..base import check_key_range
-            check_key_range(keys, self.server.num_keys, "intent key")
             np.maximum.at(ie[shard], keys, end)
         if self.server.tracer is not None:
             from ..utils.stats import INTENT_START

@@ -52,6 +52,12 @@ import numpy as np
 from ..core.store import OOB
 
 
+def _key_dtype(num_keys: int):
+    """Key-upload dtype: int32 halves the transfer and is exact as long as
+    every key fits; beyond 2^31 keys fall back to int64."""
+    return np.int32 if num_keys <= 2**31 else np.int64
+
+
 class Routes:
     """Device index arrays routing one role's key batch to pool rows.
 
@@ -358,7 +364,7 @@ class DeviceRoutedRunner:
         if len(idx) == 0:
             idx = pop  # nothing local: draw from the full population
         cap = bucket_size(len(idx), minimum=64)
-        padded = np.zeros(cap, dtype=np.int32)
+        padded = np.zeros(cap, dtype=_key_dtype(srv.num_keys))
         padded[: len(idx)] = idx
         self._local_index = (jnp.asarray(padded),
                              jnp.int32(len(idx)))
@@ -390,10 +396,8 @@ class DeviceRoutedRunner:
             local_index = self._local_neg_index() \
                 if self.neg_role is not None else None
             self._rng, sub = jax.random.split(self._rng)
-            # int32 keys halve the upload; validated above to be inside
-            # [0, num_keys), so int32 is exact unless the key space itself
-            # exceeds 2^31
-            kdtype = np.int32 if srv.num_keys <= 2**31 else np.int64
+            # keys validated above to be inside [0, num_keys)
+            kdtype = _key_dtype(srv.num_keys)
             keys = {r: jnp.asarray(np.asarray(k, dtype=kdtype))
                     for r, k in role_keys.items()}
             pools = tuple((s.main, s.cache, s.delta) for s in srv.stores)

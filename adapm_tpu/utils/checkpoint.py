@@ -61,6 +61,12 @@ def restore_server(server, path: str) -> None:
         ab.replica_count[:] = (ab.cache_slot >= 0).sum(axis=0)
         server.sync.intent_end[:] = ck["intent_end"]
         server._clocks[:] = ck["clocks"]
+        # Workers registered before the restore carry their own _clock and
+        # write it back on advance_clock — re-seed them so the first advance
+        # after a restore can't regress the restored clocks (intent windows
+        # and replica expiry are computed from these).
+        for wid, w in server._workers.items():
+            w._clock = int(server._clocks[wid])
 
         # pools back onto the mesh with their original shardings
         for cid, st in enumerate(server.stores):

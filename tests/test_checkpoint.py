@@ -79,3 +79,38 @@ def test_restore_rejects_mismatch(tmp_path):
     except AssertionError as e:
         assert "mismatch" in str(e)
     other.shutdown()
+
+
+def test_restore_reseeds_existing_worker_clocks(tmp_path):
+    """A worker created before restore must not regress the restored clocks
+    on its first advance (intent windows / replica expiry read these)."""
+    srv, (w0, w1) = _adapted_server()
+    for _ in range(7):
+        w0.advance_clock()
+    for _ in range(3):
+        w1.advance_clock()
+    path = str(tmp_path / "ck.npz")
+    save_server(srv, path)
+    srv.shutdown()
+
+    srv2 = adapm_tpu.setup(
+        32, 4, opts=SystemOptions(sync_max_per_sec=0,
+                                  cache_slots_per_shard=16))
+    w0b = srv2.make_worker(0)
+    w1b = srv2.make_worker(1)
+    restore_server(srv2, path)
+    assert w0b.current_clock == 7 and w1b.current_clock == 3
+    assert w0b.advance_clock() == 8
+    assert (srv2._clocks[:2] == [8, 3]).all()
+    srv2.shutdown()
+
+    # restore-first ordering (the natural resume sequence): a worker created
+    # AFTER restore seeds from the restored clock table
+    srv3 = adapm_tpu.setup(
+        32, 4, opts=SystemOptions(sync_max_per_sec=0,
+                                  cache_slots_per_shard=16))
+    restore_server(srv3, path)
+    w0c = srv3.make_worker(0)
+    assert w0c.current_clock == 7
+    assert w0c.advance_clock() == 8
+    srv3.shutdown()

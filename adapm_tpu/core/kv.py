@@ -90,7 +90,8 @@ class Server:
         for cid, L in enumerate(self.class_lengths):
             self.stores.append(ShardedStore(
                 int(class_counts[cid]), L, self.ctx, dtype=self.dtype,
-                cache_slots_per_shard=self.opts.cache_slots_per_shard))
+                cache_slots_per_shard=self.opts.cache_slots_per_shard,
+                bucket_min=self.opts.remote_bucket_min))
         self.ab = Addressbook(
             key_class, self.ctx.num_shards,
             [s.main_slots for s in self.stores],
@@ -313,7 +314,11 @@ class Server:
                     self.tracer.record(created, REPLICA_SETUP, shard)
             return created
 
-    def _sync_replicas(self, items: List[Tuple[int, int]]) -> None:
+    def _sync_replicas(self, items: List[Tuple[int, int]],
+                       threshold: float = 0.0) -> None:
+        """threshold > 0 leaves small-delta replicas out of the round
+        (--sys.sync.threshold); drop/quiesce paths pass 0 so no pending
+        delta is ever lost."""
         with self._lock:
             ab = self.ab
             karr = np.array([k for k, _ in items], dtype=np.int64)
@@ -323,7 +328,8 @@ class Server:
                 r_cs = ab.cache_slot[ss, ks].astype(np.int32)
                 o_sh = ab.owner[ks].astype(np.int32)
                 o_sl = ab.slot[ks].astype(np.int32)
-                self.stores[cid].sync_replicas(ss, r_cs, o_sh, o_sl)
+                self.stores[cid].sync_replicas(ss, r_cs, o_sh, o_sl,
+                                               threshold=threshold)
 
     def _drop_replicas(self, items: List[Tuple[int, int]]) -> None:
         with self._lock:

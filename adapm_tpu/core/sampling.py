@@ -58,6 +58,10 @@ class SamplingBase:
         self._handles: Dict[Tuple[int, int], _Handle] = {}
         self._next_id: Dict[int, int] = {}
         self._seed = seed
+        # RNG batching (--sampling.batch_size, reference sampling.h:394-405):
+        # per-worker buffer of pre-drawn keys so small draws (WOR probes draw
+        # one key at a time) amortize the app sample_key_fn call
+        self._draw_buf: Dict[int, Tuple[np.ndarray, int]] = {}
         # per-scheme access stats (reference sampling.h:85-97)
         self.stats = {"prepared": 0, "pulled": 0, "pulled_local": 0}
 
@@ -68,9 +72,19 @@ class SamplingBase:
         return self._rngs[wid]
 
     def _draw(self, n: int, worker) -> np.ndarray:
-        keys = np.asarray(self.sample_key_fn(n, self._rng(worker)),
-                          dtype=np.int64)
-        return keys
+        bs = self.opts.sampling_batch_size
+        if bs <= 1 or n >= bs:
+            return np.asarray(self.sample_key_fn(n, self._rng(worker)),
+                              dtype=np.int64)
+        wid = worker.worker_id
+        buf, pos = self._draw_buf.get(wid, (None, 0))
+        if buf is None or pos + n > len(buf):
+            buf = np.asarray(self.sample_key_fn(bs, self._rng(worker)),
+                             dtype=np.int64)
+            pos = 0
+        out = buf[pos:pos + n]
+        self._draw_buf[wid] = (buf, pos + n)
+        return out
 
     def _draw_wor(self, n: int, worker, seen: set) -> np.ndarray:
         """Draw without replacement against `seen` (rejection sampling,

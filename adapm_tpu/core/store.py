@@ -114,6 +114,27 @@ def _sync_replicas(main, cache, delta, r_shard, r_cslot, o_shard, o_slot):
     return main, cache, delta
 
 
+@partial(jax.jit, donate_argnums=(0, 1, 2))
+def _sync_replicas_thresholded(main, cache, delta, r_shard, r_cslot,
+                               o_shard, o_slot, threshold):
+    """_sync_replicas with the reference's sync threshold
+    (--sys.sync.threshold, handle.h:601-662, sync_manager.h:805-814): a
+    replica whose pending delta is small (max-abs below threshold) is left
+    out of the round entirely — no owner merge, no refresh — so tiny updates
+    keep accumulating locally instead of paying sync traffic. The delta is
+    never lost: it ships in a later round once it grows, or unconditionally
+    on drop/quiesce."""
+    dvals = delta.at[r_shard, r_cslot].get(mode="fill", fill_value=0)
+    ship = jnp.max(jnp.abs(dvals), axis=1) >= threshold
+    r_cslot = jnp.where(ship, r_cslot, OOB)
+    o_slot = jnp.where(ship, o_slot, OOB)
+    main = main.at[o_shard, o_slot].add(dvals, mode="drop")
+    fresh = main.at[o_shard, o_slot].get(mode="fill", fill_value=0)
+    cache = cache.at[r_shard, r_cslot].set(fresh, mode="drop")
+    delta = delta.at[r_shard, r_cslot].set(jnp.zeros_like(fresh), mode="drop")
+    return main, cache, delta
+
+
 @partial(jax.jit, donate_argnums=(0, 1))
 def _relocate(main, delta, old_shard, old_slot, new_shard, new_slot,
               rc_shard, rc_slot):
@@ -136,10 +157,14 @@ class ShardedStore:
 
     def __init__(self, num_keys_in_class: int, value_length: int,
                  ctx: MeshContext, dtype=jnp.float32, over_alloc: float = 1.25,
-                 cache_slots_per_shard: int = 0):
+                 cache_slots_per_shard: int = 0, bucket_min: int = 8):
         self.value_length = value_length
         self.ctx = ctx
         self.dtype = dtype
+        # min padded batch size (--sys equivalent: remote_bucket_min) — a
+        # larger floor means fewer distinct bucket shapes, i.e. fewer XLA
+        # compilations, at the cost of padding work on tiny batches
+        self.bucket_min = max(1, bucket_min)
         S = ctx.num_shards
         per_shard = max(1, math.ceil(num_keys_in_class / S))
         self.main_slots = max(1, math.ceil(per_shard * over_alloc))
@@ -164,20 +189,21 @@ class ShardedStore:
     def gather(self, o_shard, o_slot, c_shard, c_slot, use_cache):
         n = len(o_shard)
         a = pad_bucket(n, (o_shard, 0), (o_slot, OOB), (c_shard, 0),
-                       (c_slot, OOB), (use_cache, False))
+                       (c_slot, OOB), (use_cache, False),
+                       minimum=self.bucket_min)
         return _gather(self.main, self.cache, self.delta, *a)
 
     def scatter_add(self, o_shard, o_slot, d_shard, d_slot, vals):
         n = len(o_shard)
         a = pad_bucket(n, (o_shard, 0), (o_slot, OOB), (d_shard, 0),
-                       (d_slot, OOB))
+                       (d_slot, OOB), minimum=self.bucket_min)
         v = self._vals_bucket(vals, a[0].shape[0])
         self.main, self.delta = _scatter_add(self.main, self.delta, *a, v)
 
     def set_rows(self, o_shard, o_slot, vals, c_shard, c_slot):
         n = len(o_shard)
         a = pad_bucket(n, (o_shard, 0), (o_slot, OOB), (c_shard, 0),
-                       (c_slot, OOB))
+                       (c_slot, OOB), minimum=self.bucket_min)
         v = self._vals_bucket(vals, a[0].shape[0])
         self.main, self.cache, self.delta = _set_rows(
             self.main, self.cache, self.delta, a[0], a[1], v, a[2], a[3])
@@ -185,22 +211,29 @@ class ShardedStore:
     def replica_create(self, o_shard, o_slot, c_shard, c_slot):
         n = len(o_shard)
         a = pad_bucket(n, (o_shard, 0), (o_slot, OOB), (c_shard, 0),
-                       (c_slot, OOB))
+                       (c_slot, OOB), minimum=self.bucket_min)
         self.cache, self.delta = _replica_create(
             self.main, self.cache, self.delta, *a)
 
-    def sync_replicas(self, r_shard, r_cslot, o_shard, o_slot):
+    def sync_replicas(self, r_shard, r_cslot, o_shard, o_slot,
+                      threshold: float = 0.0):
         n = len(r_shard)
         a = pad_bucket(n, (r_shard, 0), (r_cslot, OOB), (o_shard, 0),
-                       (o_slot, OOB))
-        self.main, self.cache, self.delta = _sync_replicas(
-            self.main, self.cache, self.delta, *a)
+                       (o_slot, OOB), minimum=self.bucket_min)
+        if threshold > 0.0:
+            self.main, self.cache, self.delta = _sync_replicas_thresholded(
+                self.main, self.cache, self.delta, *a,
+                jnp.asarray(threshold, self.dtype))
+        else:
+            self.main, self.cache, self.delta = _sync_replicas(
+                self.main, self.cache, self.delta, *a)
 
     def relocate_rows(self, old_shard, old_slot, new_shard, new_slot,
                       rc_shard, rc_slot):
         n = len(old_shard)
         a = pad_bucket(n, (old_shard, 0), (old_slot, OOB), (new_shard, 0),
-                       (new_slot, OOB), (rc_shard, 0), (rc_slot, OOB))
+                       (new_slot, OOB), (rc_shard, 0), (rc_slot, OOB),
+                       minimum=self.bucket_min)
         self.main, self.delta = _relocate(self.main, self.delta, *a)
 
     def block(self) -> None:

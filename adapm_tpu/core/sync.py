@@ -41,6 +41,10 @@ class SyncStats:
         self.replicas_created = 0
         self.replicas_dropped = 0
         self.relocations = 0
+        # replicas *considered* by sync rounds; with sync_threshold > 0 the
+        # ship/hold decision is made on device, so held-back small-delta
+        # replicas are still counted here (an exact shipped count would cost
+        # a device readback per round)
         self.keys_synced = 0
         self.intents_processed = 0
 
@@ -196,7 +200,8 @@ class SyncManager:
             drop = [(k, s) for k, s in items
                     if self.intent_end[s, k] < min_clocks[s]]
         if keep:
-            self.server._sync_replicas(keep)
+            self.server._sync_replicas(
+                keep, threshold=self.opts.sync_threshold)
             self.stats.keys_synced += len(keep)
         if drop:
             if self.server.tracer is not None:

@@ -1,0 +1,109 @@
+"""Config knobs that tune the data plane: --sys.sync.threshold,
+--sampling.batch_size, remote_bucket_min (reference sync_manager.h:805-814,
+sampling.h:394-405)."""
+import numpy as np
+
+import adapm_tpu
+from adapm_tpu.base import CLOCK_MAX, MgmtTechniques
+from adapm_tpu.config import SystemOptions
+
+
+def _replicated_key(srv, w0):
+    """Force a replica of a non-local key onto w0's shard."""
+    key = next(k for k in range(srv.num_keys)
+               if srv.ab.owner[k] != w0.shard)
+    w0.intent(np.array([key]), 0, CLOCK_MAX)
+    srv.wait_sync()
+    assert srv.ab.cache_slot[w0.shard, key] >= 0, "replica not created"
+    return key
+
+
+def test_sync_threshold_holds_back_small_deltas():
+    opts = SystemOptions(techniques=MgmtTechniques.REPLICATION_ONLY,
+                         sync_threshold=1e-3, sync_max_per_sec=0,
+                         cache_slots_per_shard=8)
+    srv = adapm_tpu.setup(16, 4, opts=opts)
+    w0 = srv.make_worker(0)
+    w0.set(np.arange(16), np.ones((16, 4), np.float32))
+    key = _replicated_key(srv, w0)
+
+    # tiny delta: below threshold, stays pending through a sync round
+    w0.push(np.array([key]), np.full((1, 4), 1e-5, np.float32))
+    srv.wait_sync()
+    assert np.allclose(srv.read_main(np.array([key])), 1.0)
+    # read-your-writes on the replica still holds
+    assert np.allclose(w0.pull_sync(np.array([key])), 1.0 + 1e-5)
+
+    # once the delta grows past the threshold it ships
+    w0.push(np.array([key]), np.ones((1, 4), np.float32))
+    srv.wait_sync()
+    assert np.allclose(srv.read_main(np.array([key])), 2.0 + 1e-5)
+
+    # quiesce flushes unconditionally — no delta is ever lost
+    w0.push(np.array([key]), np.full((1, 4), 1e-5, np.float32))
+    srv.quiesce()
+    assert np.allclose(srv.read_main(np.array([key])), 2.0 + 2e-5)
+    srv.shutdown()
+
+
+def test_sync_threshold_drop_flushes_pending_delta():
+    """Replica drop (intent expiry) must flush even sub-threshold deltas."""
+    opts = SystemOptions(techniques=MgmtTechniques.REPLICATION_ONLY,
+                         sync_threshold=1e-3, sync_max_per_sec=0,
+                         cache_slots_per_shard=8)
+    srv = adapm_tpu.setup(16, 4, opts=opts)
+    w0 = srv.make_worker(0)
+    w0.set(np.arange(16), np.ones((16, 4), np.float32))
+    key = next(k for k in range(srv.num_keys)
+               if srv.ab.owner[k] != w0.shard)
+    w0.intent(np.array([key]), 0, 2)  # expires at clock 3
+    srv.wait_sync()
+    assert srv.ab.cache_slot[w0.shard, key] >= 0
+    w0.push(np.array([key]), np.full((1, 4), 1e-5, np.float32))
+    for _ in range(4):
+        w0.advance_clock()
+    srv.wait_sync()  # intent expired -> replica dropped, delta flushed
+    assert srv.ab.cache_slot[w0.shard, key] < 0, "replica should be dropped"
+    assert np.allclose(srv.read_main(np.array([key])), 1.0 + 1e-5)
+    srv.shutdown()
+
+
+def test_sampling_batch_size_buffers_rng_draws():
+    calls = []
+
+    def sample_fn(n, rng):
+        calls.append(n)
+        return rng.integers(0, 32, n)
+
+    opts = SystemOptions(sampling_scheme="naive", sampling_batch_size=64,
+                         sync_max_per_sec=0)
+    srv = adapm_tpu.setup(32, 4, opts=opts)
+    w = srv.make_worker(0)
+    w.set(np.arange(32), np.ones((32, 4), np.float32))
+    srv.enable_sampling_support(sample_fn)
+    for _ in range(8):
+        h = w.prepare_sample(5)
+        keys, vals = w.pull_sample(h)
+        assert len(keys) == 5 and vals.shape == (5, 4)
+        w.finish_sample(h)
+    # 8 * 5 = 40 draws served by a single 64-key buffered call
+    assert calls == [64], calls
+    # large draws bypass the buffer
+    h = w.prepare_sample(200)
+    keys, _ = w.pull_sample(h)
+    assert len(keys) == 200
+    assert calls == [64, 200], calls
+    srv.shutdown()
+
+
+def test_remote_bucket_min_sets_padding_floor():
+    opts = SystemOptions(remote_bucket_min=32, sync_max_per_sec=0)
+    srv = adapm_tpu.setup(64, 4, opts=opts)
+    assert all(s.bucket_min == 32 for s in srv.stores)
+    w = srv.make_worker(0)
+    w.set(np.arange(64), np.ones((64, 4), np.float32))
+    # tiny op still correct under the larger padding floor
+    w.push(np.array([3]), np.full((1, 4), 2.0, np.float32))
+    srv.block()
+    assert np.allclose(srv.read_main(np.array([3])), 3.0)
+    srv.shutdown()

@@ -78,12 +78,11 @@ class Server:
         self.val_offsets = np.zeros(self.num_keys + 1, dtype=np.int64)
         np.cumsum(self.value_lengths, out=self.val_offsets[1:])
 
-        # length classes
+        # length classes (vectorized: uniq is sorted, so searchsorted is the
+        # length -> class map)
         uniq = np.unique(self.value_lengths)
         self.class_lengths = [int(u) for u in uniq]
-        len_to_class = {L: i for i, L in enumerate(self.class_lengths)}
-        key_class = np.array([len_to_class[int(l)] for l in self.value_lengths],
-                             dtype=np.int32)
+        key_class = np.searchsorted(uniq, self.value_lengths).astype(np.int32)
         class_counts = np.bincount(key_class, minlength=len(uniq))
 
         self.stores: List[ShardedStore] = []
@@ -281,38 +280,38 @@ class Server:
 
     # -- planner ops (called by SyncManager) ---------------------------------
 
-    def _create_replicas(self, keys: np.ndarray, shard: int) -> List[int]:
-        """Allocate+materialize replicas on `shard`; returns created keys."""
+    def _create_replicas(self, keys: np.ndarray, shard: int) -> np.ndarray:
+        """Allocate+materialize replicas on `shard`; returns created keys.
+        Batched end to end (reference creates replica stubs per key under
+        per-key locks, handle.h:484-532; here one allocator batch + one
+        device program per length class). A full cache pool truncates the
+        batch: surplus keys stay remote — slower, never wrong."""
         with self._lock:
             ab = self.ab
             mask = ~ab.is_local(keys, shard)
             todo = np.unique(keys[mask])
             if len(todo) == 0:
-                return []
-            created: List[int] = []
+                return np.empty(0, dtype=np.int64)
+            created = []
             for cid, pos in self._group_by_class(todo):
-                alloc = ab.cache_alloc[cid]
-                taken = []
-                for k in todo[pos]:
-                    if alloc.num_free(shard) == 0:
-                        break  # cache pool full: key stays remote
-                    ab.add_replica(int(k), shard)
-                    taken.append(int(k))
-                if not taken:
+                cs = ab.add_replicas(todo[pos], shard)
+                ks = todo[pos][: len(cs)]
+                if len(ks) == 0:
                     continue
-                ks = np.asarray(taken, dtype=np.int64)
-                c_sl = ab.cache_slot[shard, ks].astype(np.int32)
+                c_sl = cs.astype(np.int32)
                 o_sh = ab.owner[ks].astype(np.int32)
                 o_sl = ab.slot[ks].astype(np.int32)
                 c_sh = np.full_like(o_sh, shard)
                 self.stores[cid].replica_create(o_sh, o_sl, c_sh, c_sl)
-                created.extend(int(k) for k in ks)
-            if created:
-                self.topology_version += 1
-                if self.tracer is not None:
-                    from ..utils.stats import REPLICA_SETUP
-                    self.tracer.record(created, REPLICA_SETUP, shard)
-            return created
+                created.append(ks)
+            if not created:
+                return np.empty(0, dtype=np.int64)
+            out = np.concatenate(created)
+            self.topology_version += 1
+            if self.tracer is not None:
+                from ..utils.stats import REPLICA_SETUP
+                self.tracer.record(out, REPLICA_SETUP, shard)
+            return out
 
     def _sync_replicas(self, items: List[Tuple[int, int]],
                        threshold: float = 0.0) -> None:
@@ -334,65 +333,81 @@ class Server:
     def _drop_replicas(self, items: List[Tuple[int, int]]) -> None:
         with self._lock:
             # flush pending deltas first (base refresh is harmless), then
-            # free the slots (reference readAndPotentiallyDropReplica)
+            # free the slots (reference readAndPotentiallyDropReplica) —
+            # grouped per (shard, class), not per key
             self._sync_replicas(items)
-            for k, s in items:
-                self.ab.drop_replica(int(k), int(s))
+            karr = np.fromiter((k for k, _ in items), np.int64, len(items))
+            sarr = np.fromiter((s for _, s in items), np.int32, len(items))
+            for s in np.unique(sarr):
+                sk = karr[sarr == s]
+                for _, pos in self._group_by_class(sk):
+                    self.ab.drop_replicas(sk[pos], int(s))
                 if self.tracer is not None:
                     from ..utils.stats import REPLICA_DROP
-                    self.tracer.record(k, REPLICA_DROP, int(s))
+                    self.tracer.record(sk, REPLICA_DROP, int(s))
             self.topology_version += 1
 
     def _relocate(self, moves: List[Tuple[int, int]]) -> int:
-        """Move main copies. Returns the number of moves actually performed;
-        a move whose destination main pool is full is demoted to a
-        replication attempt (the planner's graceful-degradation policy,
-        sync.py _register) rather than silently dropped."""
+        """Move main copies given (key, dest_shard) pairs. Returns the number
+        of moves actually performed; see _relocate_to."""
+        if not moves:
+            return 0
+        karr = np.fromiter((k for k, _ in moves), np.int64, len(moves))
+        sarr = np.fromiter((s for _, s in moves), np.int32, len(moves))
+        return sum(self._relocate_to(karr[sarr == dest], int(dest))
+                   for dest in np.unique(sarr))
+
+    def _relocate_to(self, keys: np.ndarray, dest: int) -> int:
+        """Move the main copies of `keys` to shard `dest` (the drain path's
+        shape: one destination per intent entry). Batched per class: one
+        allocator batch + one device program. A move whose destination main
+        pool is full is demoted to a replication attempt (the planner's
+        graceful-degradation policy, sync.py _register) rather than
+        silently dropped."""
+        from .sync import key_channel
+        demoted = np.empty(0, dtype=np.int64)
+        n_moved = 0
         with self._lock:
             ab = self.ab
-            moves = [(int(k), int(s)) for k, s in moves
-                     if int(s) != int(ab.owner[int(k)])]
-            if not moves:
+            keys = keys[ab.owner[keys] != dest]
+            if len(keys) == 0:
                 return 0
-            moved = 0
-            demoted: Dict[int, List[int]] = {}
-            karr = np.array([k for k, _ in moves], dtype=np.int64)
-            sarr = np.array([s for _, s in moves], dtype=np.int32)
-            for cid, pos in self._group_by_class(karr):
-                old_sh, old_sl, new_sh, new_sl, rc_sh, rc_sl = \
-                    [], [], [], [], [], []
-                for k, s in zip(karr[pos], sarr[pos]):
-                    k, s = int(k), int(s)
-                    if ab.main_alloc[cid].num_free(s) == 0:
-                        demoted.setdefault(s, []).append(k)
-                        continue
-                    cs = int(ab.cache_slot[s, k])
-                    if cs >= 0:
-                        rc_sh.append(s); rc_sl.append(cs)
-                        ab.drop_replica(k, s)
-                        self.sync.replicas[self.sync._chan(k)].discard((k, s))
-                    else:
-                        rc_sh.append(0); rc_sl.append(int(OOB))
-                    osh, osl, nsl = ab.relocate(k, s)
-                    old_sh.append(osh); old_sl.append(osl)
-                    new_sh.append(s); new_sl.append(nsl)
-                    if self.tracer is not None:
-                        from ..utils.stats import RELOCATE
-                        self.tracer.record(k, RELOCATE, s)
-                if not old_sh:
+            for cid, pos in self._group_by_class(keys):
+                ks = keys[pos]
+                moved, old_sh, old_sl, new_sl = ab.relocate_batch(ks, dest)
+                if len(moved) < len(ks):  # pool full: demote the rest
+                    demoted = np.concatenate((demoted, ks[len(moved):]))
+                if len(moved) == 0:
                     continue
+                # a replica at the destination upgrades to owner: its
+                # pending delta merges in-kernel (rc coords), and its
+                # cache slot is freed
+                cs = ab.cache_slot[dest, moved]
+                has_rep = cs >= 0
+                rc_sh = np.where(has_rep, dest, 0).astype(np.int32)
+                rc_sl = np.where(has_rep, cs, OOB).astype(np.int32)
+                rep_keys = moved[has_rep]
+                if len(rep_keys):
+                    chans = key_channel(rep_keys, self.sync.num_channels)
+                    for k, c in zip(rep_keys.tolist(), chans.tolist()):
+                        self.sync.replicas[c].discard((k, dest))
+                    ab.drop_replicas(rep_keys, dest)
                 self.stores[cid].relocate_rows(
-                    np.array(old_sh, np.int32), np.array(old_sl, np.int32),
-                    np.array(new_sh, np.int32), np.array(new_sl, np.int32),
-                    np.array(rc_sh, np.int32), np.array(rc_sl, np.int32))
-                moved += len(old_sh)
-            if moved:
+                    old_sh.astype(np.int32), old_sl.astype(np.int32),
+                    np.full(len(moved), dest, np.int32),
+                    new_sl.astype(np.int32), rc_sh, rc_sl)
+                n_moved += len(moved)
+                if self.tracer is not None:
+                    from ..utils.stats import RELOCATE
+                    self.tracer.record(moved, RELOCATE, dest)
+            if n_moved:
                 self.topology_version += 1
-        for s, ks in demoted.items():
-            created = self._create_replicas(np.asarray(ks, np.int64), s)
-            for k in created:
-                self.sync.replicas[self.sync._chan(k)].add((k, s))
-        return moved
+        if len(demoted):
+            created = self._create_replicas(demoted, dest)
+            chans = key_channel(created, self.sync.num_channels)
+            for k, c in zip(created.tolist(), chans.tolist()):
+                self.sync.replicas[c].add((k, dest))
+        return n_moved
 
     # -- lifecycle -----------------------------------------------------------
 

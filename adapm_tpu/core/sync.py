@@ -18,8 +18,7 @@ per-round payload.
 from __future__ import annotations
 
 import time
-from collections import defaultdict
-from typing import Dict, List, Optional, Set, Tuple
+from typing import List, Set, Tuple
 
 import numpy as np
 
@@ -60,8 +59,11 @@ class SyncManager:
         K = server.num_keys
         # per-shard registered intent horizon: max end clock of any active
         # intent by a worker on that shard (reference: Parameter.local_intents
-        # per customer, handle.h:122-152, aggregated to the node level)
-        self.intent_end = np.full((S, K), -1, dtype=np.int64)
+        # per customer, handle.h:122-152, aggregated to the node level).
+        # int32: clocks are bounded by CLOCK_MAX = 2^31-1 (base.py), and at
+        # Wikidata5M scale this table is S x 5M — int64 would double its
+        # footprint for no range benefit
+        self.intent_end = np.full((S, K), -1, dtype=np.int32)
         # live replicas, partitioned by channel: channel -> set[(key, shard)]
         self.replicas: List[Set[Tuple[int, int]]] = [
             set() for _ in range(self.num_channels)]
@@ -92,26 +94,31 @@ class SyncManager:
                 # actions are applied per intent entry: a later intent in the
                 # same drain must observe placement changes made by earlier
                 # ones, or locality decisions go stale
-                relocations: List[Tuple[int, int]] = []
-                replications: Dict[int, List[int]] = defaultdict(list)
-                self._register(w.shard, keys, end, relocations, replications)
+                relocate_keys, replicate_keys = self._register(
+                    w.shard, keys, end)
                 self.stats.intents_processed += len(keys)
-                if relocations:
-                    self.stats.relocations += self.server._relocate(
-                        relocations)
-                for shard, ks in replications.items():
+                if len(relocate_keys):
+                    self.stats.relocations += self.server._relocate_to(
+                        relocate_keys, w.shard)
+                if len(replicate_keys):
                     created = self.server._create_replicas(
-                        np.asarray(ks, dtype=np.int64), shard)
-                    for k in created:
-                        self.replicas[self._chan(k)].add((k, shard))
+                        replicate_keys, w.shard)
+                    chans = key_channel(created, self.num_channels)
+                    for k, c in zip(created.tolist(), chans.tolist()):
+                        self.replicas[c].add((k, w.shard))
                     self.stats.replicas_created += len(created)
 
     def _chan(self, key: int) -> int:
         return int(key_channel(np.asarray([key]), self.num_channels)[0])
 
-    def _register(self, shard: int, keys: np.ndarray, end: int,
-                  relocations, replications) -> None:
-        ab = self.server.ab
+    def _register(self, shard: int, keys: np.ndarray,
+                  end: int) -> Tuple[np.ndarray, np.ndarray]:
+        """Register an intent batch; returns (keys to relocate to `shard`,
+        keys to replicate onto `shard`). Fully vectorized — no per-key
+        Python (the reference is O(1)/key in C++, addressbook.h:110-151).
+        Capacity degradation (full pools) is handled downstream: _relocate
+        demotes to replication, _create_replicas truncates — slower for the
+        surplus keys, never wrong."""
         ie = self.intent_end
         # validate up front so the native and numpy paths leave identical
         # intent_end state when the batch contains a bad key (the C helper
@@ -123,54 +130,40 @@ class SyncManager:
                 np.ascontiguousarray(keys, np.int64), len(keys),
                 self.server.num_keys, int(end), ie[shard])
         else:
-            np.maximum.at(ie[shard], keys, end)
+            np.maximum.at(ie[shard], keys, np.int32(min(end, 2**31 - 1)))
         if self.server.tracer is not None:
             from ..utils.stats import INTENT_START
             self.server.tracer.record(keys, INTENT_START, shard)
         # keys that are not yet available on `shard`
-        nonlocal_mask = ~ab.is_local(keys, shard)
-        for k in keys[nonlocal_mask]:
-            k = int(k)
-            action = self._decide(k, shard)
-            cls = int(ab.key_class[k])
-            # graceful degradation under full pools (the reference's store is
-            # an unbounded hash map; ours is a fixed HBM pool): a relocation
-            # with no free main slot becomes a replication; a replication
-            # with no free cache slot is skipped (key stays remote — slower,
-            # never wrong)
-            if action == "relocate" and \
-                    ab.main_alloc[cls].num_free(shard) == 0:
-                action = "replicate"
-            if action == "replicate" and \
-                    ab.cache_alloc[cls].num_free(shard) == 0:
-                continue
-            if action == "relocate":
-                relocations.append((k, shard))
-            else:
-                replications[shard].append(k)
+        cand = keys[~self.server.ab.is_local(keys, shard)]
+        if len(cand) == 0:
+            e = np.empty(0, dtype=np.int64)
+            return e, e
+        relocate = self._decide_batch(cand, shard)
+        return cand[relocate], cand[~relocate]
 
-    def _decide(self, key: int, shard: int) -> str:
+    def _decide_batch(self, keys: np.ndarray, shard: int) -> np.ndarray:
         """Relocate vs replicate (reference sync_manager.h:624-644): relocate
-        iff no *other* shard currently has interest in the key (an active
-        intent or a replica) — otherwise replicate."""
+        iff no *other* shard currently has interest in any of the keys (an
+        active intent or a replica) — otherwise replicate. Returns a bool
+        mask (True = relocate)."""
         t = self.opts.techniques
         if t == MgmtTechniques.REPLICATION_ONLY:
-            return "replicate"
+            return np.zeros(len(keys), dtype=bool)
         if t == MgmtTechniques.RELOCATION_ONLY:
-            return "relocate"
+            return np.ones(len(keys), dtype=bool)
         ab = self.server.ab
         clocks = self.server.shard_min_clocks()
+        other_interest = np.zeros(len(keys), dtype=bool)
         for s in range(self.server.num_shards):
             if s == shard:
                 continue
-            if ab.cache_slot[s, key] != NO_SLOT:
-                return "replicate"
-            if self.intent_end[s, key] >= clocks[s]:
-                # any other shard's active intent blocks relocation; the
-                # reference distinguishes owner-local intent and remote node
-                # intent but blocks relocation on either (:624-644)
-                return "replicate"
-        return "relocate"
+            # any other shard's active intent or replica blocks relocation;
+            # the reference distinguishes owner-local and remote node intent
+            # but blocks relocation on either (:624-644)
+            other_interest |= (ab.cache_slot[s, keys] != NO_SLOT) | \
+                (self.intent_end[s, keys] >= clocks[s])
+        return ~other_interest
 
     # ------------------------------------------------------------------
     # sync rounds

@@ -107,10 +107,10 @@ def get_lib() -> Optional[ctypes.CDLL]:
         lib.adapm_intent_max.restype = ctypes.c_int64
         lib.adapm_intent_max.argtypes = [i64p, ctypes.c_int64,
                                          ctypes.c_int64, ctypes.c_int64,
-                                         i64p]
+                                         i32p]
         lib.adapm_replica_scan.restype = ctypes.c_int64
         lib.adapm_replica_scan.argtypes = [
-            i64p, i32p, ctypes.c_int64, i64p, i64p, ctypes.c_int64, u8p]
+            i64p, i32p, ctypes.c_int64, i32p, i64p, ctypes.c_int64, u8p]
         _lib = lib
         return _lib
 

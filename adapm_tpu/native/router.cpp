@@ -74,13 +74,16 @@ int64_t adapm_count(const int64_t* keys, const uint8_t* local, int64_t n,
 
 // Intent bookkeeping: intent_end[k] = max(intent_end[k], end) for a key
 // batch (SyncManager._register's np.maximum.at). Returns skipped count.
+// intent_end is int32 ([S, K] at 5M+ keys — int64 would double the
+// footprint; clocks are bounded by CLOCK_MAX = 2^31-1).
 int64_t adapm_intent_max(const int64_t* keys, int64_t n, int64_t num_keys,
-                         int64_t end, int64_t* intent_end) {
+                         int64_t end, int32_t* intent_end) {
+  const int32_t e = end > 2147483647LL ? 2147483647 : (int32_t)end;
   int64_t bad = 0;
   for (int64_t i = 0; i < n; ++i) {
     const int64_t k = keys[i];
     if (k < 0 || k >= num_keys) { ++bad; continue; }
-    if (intent_end[k] < end) intent_end[k] = end;
+    if (intent_end[k] < e) intent_end[k] = e;
   }
   return bad;
 }
@@ -90,7 +93,7 @@ int64_t adapm_intent_max(const int64_t* keys, int64_t n, int64_t num_keys,
 // intent_end[shard[i]*num_keys + key[i]] >= min_clock[shard[i]].
 // Writes 1/0 into keep; returns number kept.
 int64_t adapm_replica_scan(const int64_t* keys, const int32_t* shards,
-                           int64_t n, const int64_t* intent_end,
+                           int64_t n, const int32_t* intent_end,
                            const int64_t* min_clock, int64_t num_keys,
                            uint8_t* keep) {
   int64_t kept = 0;

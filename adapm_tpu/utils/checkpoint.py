@@ -103,13 +103,10 @@ def restore_server(server, path: str) -> None:
 
 def _rebuild_alloc(alloc, owners: np.ndarray, slots: np.ndarray) -> None:
     for s in range(alloc.num_shards):
-        used = set(int(x) for x in slots[owners == s])
-        alloc._free[s] = [i for i in range(alloc.slots_per_shard - 1, -1, -1)
-                          if i not in used]
+        alloc.set_used(s, slots[owners == s])
 
 
 def _rebuild_cache_alloc(alloc, used_by_shard) -> None:
     for s in range(alloc.num_shards):
-        used = set(int(x) for x in used_by_shard[s] if x >= 0)
-        alloc._free[s] = [i for i in range(alloc.slots_per_shard - 1, -1, -1)
-                          if i not in used]
+        row = np.asarray(used_by_shard[s])
+        alloc.set_used(s, row[row >= 0])

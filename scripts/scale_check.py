@@ -1,0 +1,40 @@
+import os, time
+os.environ["ADAPM_PLATFORM"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+import jax; jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import adapm_tpu
+from adapm_tpu.config import SystemOptions
+
+t0 = time.perf_counter()
+srv = adapm_tpu.setup(5_000_000, 8, opts=SystemOptions(
+    sync_max_per_sec=0, cache_slots_per_shard=4096))
+t1 = time.perf_counter()
+print(f"Server(5M keys) construction: {t1-t0:.2f}s")
+assert t1 - t0 < 5.0, "too slow"
+
+w = srv.make_worker(0)
+# a large intent batch through the vectorized register path
+rng = np.random.default_rng(0)
+keys = rng.choice(5_000_000, 100_000, replace=False)
+t0 = time.perf_counter()
+w.intent(keys, 0, 1000)
+srv.wait_sync()
+t1 = time.perf_counter()
+print(f"100k-key intent drain + sync round: {t1-t0:.2f}s")
+print("replicas:", srv.sync.stats.replicas_created,
+      "relocations:", srv.sync.stats.relocations)
+
+# steady-state step-shaped loop: 1k rounds of routed pushes at 5M keys
+batch = rng.integers(0, 5_000_000, 4096)
+vals = np.ones((4096, 8), np.float32)
+w.push(batch, vals)  # warm compile
+srv.block()
+t0 = time.perf_counter()
+for _ in range(50):
+    w.push(batch, vals)
+srv.block()
+t1 = time.perf_counter()
+print(f"push(4096 keys) steady state: {(t1-t0)/50*1e3:.2f} ms/op")
+srv.shutdown()
+print("SCALE OK")

@@ -68,7 +68,7 @@ def test_count(lib):
 
 
 def test_intent_max(lib):
-    ie = np.full(8, -1, dtype=np.int64)
+    ie = np.full(8, -1, dtype=np.int32)
     assert lib.adapm_intent_max(np.array([1, 2, 1], dtype=np.int64),
                                 3, 8, 10, ie) == 0
     assert lib.adapm_intent_max(np.array([1], dtype=np.int64),
@@ -87,7 +87,7 @@ def test_route_bounds(lib):
 
 def test_replica_scan(lib):
     num_keys = 8
-    ie = np.full((2, num_keys), -1, dtype=np.int64)
+    ie = np.full((2, num_keys), -1, dtype=np.int32)
     ie[0, 3] = 100
     ie[1, 4] = 1
     min_clock = np.array([50, 50], dtype=np.int64)

@@ -200,14 +200,26 @@ class Worker:
 
     def pull_sample(self, sample_id: int, keys, vals,
                     asynchronous: bool = False) -> int:
+        """Draw samples into `keys` and their values into `vals`. Mirrors
+        bindings.cc:330-337: returns the underlying pull's timestamp (-1
+        when every sampled key was local, e.g. the Local scheme by
+        construction); asynchronous=True skips the wait — `vals` is filled
+        when the caller waits on the returned timestamp."""
         k = _as_numpy(keys)
         v = _as_numpy(vals)
         if not (k.flags["C_CONTIGUOUS"] and v.flags["C_CONTIGUOUS"]):
             raise ValueError("pull_sample buffers must be contiguous")
-        drawn, values = self._w.pull_sample(sample_id, len(k))
+        drawn = self._w.pull_sample_keys(sample_id, len(k))
         k.ravel()[:] = drawn
-        v.reshape(-1)[:] = np.asarray(values, dtype=v.dtype).ravel()
-        return LOCAL
+        need = int(self._w.server.value_lengths[drawn].sum())
+        if v.size != need:
+            raise ValueError(
+                f"pull_sample value buffer has {v.size} elements; the "
+                f"{len(drawn)} sampled keys need exactly {need}")
+        ts = self._w.pull(drawn, out=v.reshape(-1))
+        if not asynchronous and ts != LOCAL:
+            self._w.wait(ts)
+        return ts
 
     # -- waiting / lifecycle -------------------------------------------------
 

@@ -369,6 +369,10 @@ class Server:
         n_moved = 0
         with self._lock:
             ab = self.ab
+            # dedup: a duplicate key would double-free its old main slot in
+            # relocate_batch (the drain path dedups in Worker.intent, but
+            # direct callers may not)
+            keys = np.unique(keys)
             keys = keys[ab.owner[keys] != dest]
             if len(keys) == 0:
                 return 0

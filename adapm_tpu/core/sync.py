@@ -108,9 +108,6 @@ class SyncManager:
                         self.replicas[c].add((k, w.shard))
                     self.stats.replicas_created += len(created)
 
-    def _chan(self, key: int) -> int:
-        return int(key_channel(np.asarray([key]), self.num_channels)[0])
-
     def _register(self, shard: int, keys: np.ndarray,
                   end: int) -> Tuple[np.ndarray, np.ndarray]:
         """Register an intent batch; returns (keys to relocate to `shard`,

@@ -119,3 +119,27 @@ def test_example_runs():
     """The bundled example (reference bindings/example.py analog)."""
     import examples.bindings_example as ex
     ex.main()
+
+
+def test_pull_sample_async_contract(server):
+    """bindings.cc:330-337: pull_sample returns the underlying pull's
+    timestamp; async skips the wait and the value buffer fills on wait."""
+    server.enable_sampling_support("naive", True, "uniform", 0, 50)
+    w = adapm.Worker(0, server)
+    # seed known values so the filled buffer is recognizable
+    allk = np.arange(50, dtype=np.int64)
+    w.set(allk, np.full((50, 4), 7.0, np.float32))
+    w.wait_sync()
+    h = w.prepare_sample(8, 0)
+    keys = np.zeros(8, dtype=np.int64)
+    vals = np.zeros((8, 4), dtype=np.float32)
+    ts = w.pull_sample(h, keys, vals, asynchronous=True)
+    if ts != -1:          # remote keys: wait fills the buffer
+        w.wait(ts)
+    assert np.allclose(vals, 7.0)
+    # sync path returns a timestamp too (possibly LOCAL = -1)
+    vals2 = np.zeros((8, 4), dtype=np.float32)
+    h2 = w.prepare_sample(8, 0)
+    ts2 = w.pull_sample(h2, keys, vals2)
+    assert isinstance(ts2, int)
+    assert np.allclose(vals2, 7.0)

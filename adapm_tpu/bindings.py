@@ -206,20 +206,13 @@ class Worker:
         construction); asynchronous=True skips the wait — `vals` is filled
         when the caller waits on the returned timestamp."""
         k = _as_numpy(keys)
-        v = _as_numpy(vals)
-        if not (k.flags["C_CONTIGUOUS"] and v.flags["C_CONTIGUOUS"]):
-            raise ValueError("pull_sample buffers must be contiguous")
+        if not k.flags["C_CONTIGUOUS"]:
+            raise ValueError("pull_sample key buffer must be contiguous")
         drawn = self._w.pull_sample_keys(sample_id, len(k))
         k.ravel()[:] = drawn
-        need = int(self._w.server.value_lengths[drawn].sum())
-        if v.size != need:
-            raise ValueError(
-                f"pull_sample value buffer has {v.size} elements; the "
-                f"{len(drawn)} sampled keys need exactly {need}")
-        ts = self._w.pull(drawn, out=v.reshape(-1))
-        if not asynchronous and ts != LOCAL:
-            self._w.wait(ts)
-        return ts
+        # the value fetch is an ordinary pull of the drawn keys: shared
+        # validation + out= fill + async contract
+        return self.pull(drawn, vals, asynchronous)
 
     # -- waiting / lifecycle -------------------------------------------------
 

@@ -6,11 +6,12 @@ the framework's fused train step (gather -> ComplEx score/grad -> AdaGrad ->
 scatter-add on the sharded HBM pools, ops/fused.py) on the available device
 and reports triples/sec.
 
-vs_baseline: the reference publishes no in-tree numbers (BASELINE.md), so the
-baseline is measured here as a proxy: the same per-triple ComplEx+AdaGrad
-update in numpy (the reference's CPU compute pattern, kge.cc:415-530, one
-triple at a time), scaled x64 for the paper's 8 nodes x 8 worker threads.
-vs_baseline = tpu_triples_per_sec / (64 * cpu_single_thread_triples_per_sec).
+vs_baseline: the reference publishes no in-tree numbers and its binary
+cannot be built in this image (ZMQ/Boost/Eigen absent, installs forbidden —
+BASELINE.md "Measured baselines"). The baseline is therefore MEASURED on
+this host: a strong batched torch-CPU implementation of the same step,
+per-core, scaled x64 for the paper's 8 nodes x 8 worker threads.
+vs_baseline = tpu_triples_per_sec / (64 * torch_cpu_per_core_triples_per_sec).
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 """
@@ -87,54 +88,76 @@ def bench_tpu(E=200_000, R=1_000, d=128, B=4096, N=32, steps=50,
     return B / dt
 
 
-def bench_cpu_reference_proxy(E=20_000, R=100, d=128, N=32,
-                              triples=300) -> float:
-    """Single-thread numpy per-triple ComplEx + AdaGrad (the reference's
-    per-data-point CPU hot loop shape, kge.cc train :437-531)."""
-    rng = np.random.default_rng(0)
-    ent = rng.normal(size=(E, 2 * d)).astype(np.float32) * 0.1
-    rel = rng.normal(size=(R, 2 * d)).astype(np.float32) * 0.1
-    ent_a = np.full((E, 2 * d), 1e-6, dtype=np.float32)
-    rel_a = np.full((R, 2 * d), 1e-6, dtype=np.float32)
+def bench_cpu_torch(E=200_000, R=1_000, d=128, B=4096, N=32,
+                    steps=3) -> float:
+    """Measured CPU baseline: the same ComplEx+AdaGrad batch step written
+    the way a competent torch user would (batched gathers, autograd on the
+    gathered rows, index_add scatter) on this host's CPU. Stronger per core
+    than the reference's per-triple C++ loop (kge.cc:437-531), so scaling
+    it to the paper's cluster size gives a *conservative* baseline."""
+    import torch
+
+    # measure true single-core throughput (dividing an all-thread time by
+    # the thread count would assume perfect intra-op scaling and inflate
+    # vs_baseline on many-core hosts)
+    torch.set_num_threads(1)
+    torch.manual_seed(0)
+    ent = torch.randn(E, 2 * d) * 0.1
+    rel = torch.randn(R, 2 * d) * 0.1
+    ent_a = torch.full((E, 2 * d), 1e-6)
+    rel_a = torch.full((R, 2 * d), 1e-6)
     lr, eps = 0.1, 1e-10
 
-    def score_grad(s, r, o):
-        sr, si = s[:d], s[d:]
-        rr, ri = r[:d], r[d:]
-        orr, oi = o[:d], o[d:]
-        sc = float((sr * rr * orr + si * rr * oi
-                    + sr * ri * oi - si * ri * orr).sum())
-        gs = np.concatenate([rr * orr + ri * oi, rr * oi - ri * orr])
-        gr = np.concatenate([sr * orr + si * oi, sr * oi - si * orr])
-        go = np.concatenate([sr * rr + si * ri, si * rr - sr * ri])
-        return sc, gs, gr, go
+    def cscore(s, r, o):
+        sr, si = s[..., :d], s[..., d:]
+        rr, ri = r[..., :d], r[..., d:]
+        orr, oi = o[..., :d], o[..., d:]
+        return (sr * rr * orr + si * rr * oi
+                + sr * ri * oi - si * ri * orr).sum(-1)
 
-    def adagrad(table, acc, idx, g):
-        acc[idx] += g * g
-        table[idx] -= lr * g / np.sqrt(acc[idx] + eps)
+    def step():
+        s = torch.randint(0, E, (B,))
+        r = torch.randint(0, R, (B,))
+        o = torch.randint(0, E, (B,))
+        n = torch.randint(0, E, (B, N))
+        se = ent[s].requires_grad_(True)
+        re_ = rel[r].requires_grad_(True)
+        oe = ent[o].requires_grad_(True)
+        ne = ent[n].requires_grad_(True)
+        pos = cscore(se, re_, oe)
+        neg = cscore(ne, re_.unsqueeze(1), oe.unsqueeze(1))
+        loss = torch.nn.functional.softplus(-pos).sum() + \
+            torch.nn.functional.softplus(neg).sum()
+        loss.backward()
 
+        def adagrad(table, acc, idx, g):
+            acc.index_add_(0, idx, g * g)
+            table.index_add_(0, idx, -lr * g / torch.sqrt(acc[idx] + eps))
+
+        adagrad(ent, ent_a, s, se.grad)
+        adagrad(rel, rel_a, r, re_.grad)
+        adagrad(ent, ent_a, o, oe.grad)
+        adagrad(ent, ent_a, n.reshape(-1), ne.grad.reshape(-1, 2 * d))
+
+    step()  # warmup
     t0 = time.perf_counter()
-    for _ in range(triples):
-        s, o = rng.integers(0, E, 2)
-        r = rng.integers(0, R)
-        sc, gs, gr, go = score_grad(ent[s], rel[r], ent[o])
-        w = 1.0 / (1.0 + np.exp(sc)) if sc < 30 else 0.0  # sigmoid'(pos)
-        adagrad(ent, ent_a, s, -w * gs)
-        adagrad(rel, rel_a, r, -w * gr)
-        adagrad(ent, ent_a, o, -w * go)
-        for n in rng.integers(0, E, 2 * N):  # corrupt both sides
-            sc, gs, gr, go = score_grad(ent[n], rel[r], ent[o])
-            w = 1.0 / (1.0 + np.exp(-sc)) if sc > -30 else 0.0
-            adagrad(ent, ent_a, n, w * gs)
-            adagrad(rel, rel_a, r, w * gr)
-            adagrad(ent, ent_a, o, w * go)
-    return triples / (time.perf_counter() - t0)
+    for _ in range(steps):
+        step()
+    per_step = (time.perf_counter() - t0) / steps
+    return B / per_step
 
 
 def main():
     tput = bench_tpu()
-    cpu = bench_cpu_reference_proxy()
-    baseline = 64.0 * cpu  # 8 nodes x 8 worker threads
+    # measured per-core CPU throughput of a strong batched torch
+    # implementation of the same step; the paper's 8-node x 8-thread
+    # cluster is modeled as 64 such cores (conservative: AdaPM's
+    # per-triple C++ loop and network overhead are both slower per core).
+    # The reference binary itself cannot be built in this image — its
+    # ZMQ/Boost/Eigen dependencies are absent and installs are forbidden
+    # (BASELINE.md "Measured baselines").
+    cpu = bench_cpu_torch()
+    baseline = 64.0 * cpu
     print(json.dumps({
         "metric": "kge_complex_train_throughput",
         "value": round(tput, 1),

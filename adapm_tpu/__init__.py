@@ -15,7 +15,11 @@ __version__ = "0.1.0"
 def setup(num_keys: int, value_lengths, opts=None, num_shards=None,
           num_workers=None):
     """Convenience: build a mesh + Server (reference `ps::Setup` +
-    `ServerT server(...)`, apps/simple.cc:107-133)."""
+    `ServerT server(...)`, apps/simple.cc:107-133). Under the launcher
+    (ADAPM_COORDINATOR set), this also joins the multi-process runtime —
+    the reference's Postoffice::Start + scheduler rendezvous."""
+    from .parallel import control
+    control.init_from_env()
     ctx = make_mesh(num_shards)
     return Server(num_keys, value_lengths, opts=opts, ctx=ctx,
                   num_workers=num_workers)

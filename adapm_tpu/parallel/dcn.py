@@ -1,0 +1,157 @@
+"""Host-side DCN data channel for cross-process parameter traffic.
+
+The reference moves every cross-node byte through its ZeroMQ van
+(include/zmq_van.h, src/van.cc). In the TPU design the *hot* data plane is
+on-device (intent makes keys local before use; SURVEY.md §2.5), so what
+remains for the network is the thin tail the reference also has: misses
+(pull/push of keys owned by another process), row fetches for replica
+creation/relocation, and delta shipping during sync rounds. Those ride this
+channel: one TCP listener per process, peer addresses rendezvoused through
+the jax.distributed coordinator's key-value store (the scheduler's
+replacement — src/van.cc:40-111 ADD_NODE ↔ key_value_set/get), length-framed
+pickle messages (protocol 5: numpy buffers serialize zero-copy).
+
+Request handling runs on a per-connection receiver thread and takes the
+server lock only around local table/pool operations — never across a nested
+channel call — so two processes pulling from each other cannot deadlock.
+"""
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+import threading
+from typing import Callable, Dict, Optional
+
+_LEN = struct.Struct("!Q")
+
+
+def _send_msg(sock: socket.socket, obj) -> None:
+    data = pickle.dumps(obj, protocol=5)
+    sock.sendall(_LEN.pack(len(data)) + data)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def _recv_msg(sock: socket.socket):
+    head = _recv_exact(sock, _LEN.size)
+    if head is None:
+        return None
+    (n,) = _LEN.unpack(head)
+    body = _recv_exact(sock, n)
+    if body is None:
+        return None
+    return pickle.loads(body)
+
+
+class DcnChannel:
+    """Request/reply channel between the launcher's processes.
+
+    `handler(msg) -> reply` is called for every incoming request on a
+    receiver thread. Outgoing `request(peer, msg)` is synchronous (send +
+    await reply) under a per-peer lock; concurrency across peers is free.
+    """
+
+    def __init__(self, process_id: int, num_processes: int,
+                 handler: Callable):
+        self.pid = process_id
+        self.num = num_processes
+        self.handler = handler
+        self._listener: Optional[socket.socket] = None
+        self._peers: Dict[int, socket.socket] = {}
+        self._peer_locks: Dict[int, threading.Lock] = {}
+        self._threads = []
+        self._stop = threading.Event()
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        from jax._src import distributed
+        client = distributed.global_state.client
+        assert client is not None, "jax.distributed not initialized"
+        self._listener = socket.socket()
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind(("0.0.0.0", 0))
+        self._listener.listen(self.num)
+        port = self._listener.getsockname()[1]
+        host = socket.gethostname()
+        client.key_value_set(f"adapm/dcn/{self.pid}", f"{host}:{port}")
+        t = threading.Thread(target=self._accept_loop, daemon=True,
+                             name="adapm-dcn-accept")
+        t.start()
+        self._threads.append(t)
+
+    def _resolve(self, peer: int) -> socket.socket:
+        sock = self._peers.get(peer)
+        if sock is not None:
+            return sock
+        from jax._src import distributed
+        client = distributed.global_state.client
+        addr = client.blocking_key_value_get(f"adapm/dcn/{peer}", 60_000)
+        host, port = addr.rsplit(":", 1)
+        sock = socket.create_connection((host, int(port)), timeout=60)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._peers[peer] = sock
+        self._peer_locks[peer] = threading.Lock()
+        return sock
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            t = threading.Thread(target=self._serve, args=(conn,),
+                                 daemon=True, name="adapm-dcn-serve")
+            t.start()
+            self._threads.append(t)
+
+    def _serve(self, conn: socket.socket) -> None:
+        while not self._stop.is_set():
+            msg = _recv_msg(conn)
+            if msg is None:
+                conn.close()
+                return
+            try:
+                reply = self.handler(msg)
+            except Exception as e:  # noqa: BLE001 - ship errors to requester
+                reply = ("error", f"{type(e).__name__}: {e}")
+            _send_msg(conn, reply)
+
+    # -- requests ------------------------------------------------------------
+
+    def request(self, peer: int, msg):
+        """Synchronous round-trip to `peer`. Raises on remote error."""
+        assert peer != self.pid, "use local ops, not a self-request"
+        sock = self._resolve(peer)
+        with self._peer_locks[peer]:
+            _send_msg(sock, msg)
+            reply = _recv_msg(sock)
+        if reply is None:
+            raise ConnectionError(f"peer {peer} closed the channel")
+        if isinstance(reply, tuple) and reply and reply[0] == "error":
+            raise RuntimeError(f"peer {peer}: {reply[1]}")
+        return reply
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+        for sock in self._peers.values():
+            try:
+                sock.close()
+            except OSError:
+                pass
+        self._peers.clear()

@@ -61,7 +61,14 @@ def make_mesh(num_shards: Optional[int] = None,
             except Exception:
                 pass  # backends already initialized differently: still
                 # usable via the explicit device list below
-        devices = jax.devices(platform) if platform else jax.devices()
+        if jax.process_count() > 1:
+            # multi-host: each process's Server owns pools on ITS devices
+            # only (the cross-process plane is the DCN channel + global
+            # sync rounds, core/kv.py); jax.devices() would include
+            # non-addressable peers
+            devices = jax.local_devices()
+        else:
+            devices = jax.devices(platform) if platform else jax.devices()
     if num_shards is None:
         num_shards = len(devices)
     if num_shards > len(devices):

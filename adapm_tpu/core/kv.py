@@ -411,6 +411,7 @@ class Server:
             chans = key_channel(created, self.sync.num_channels)
             for k, c in zip(created.tolist(), chans.tolist()):
                 self.sync.replicas[c].add((k, dest))
+            self.sync.stats.replicas_created += len(created)
         return n_moved
 
     # -- lifecycle -----------------------------------------------------------

@@ -32,7 +32,9 @@ ENV_PROC_ID = "ADAPM_PROCESS_ID"
 def init_from_env() -> bool:
     """Initialize `jax.distributed` from launcher env vars; returns True if
     a multi-process runtime was set up (reference Postoffice::Start +
-    Van ADD_NODE handshake, collapsed into one call)."""
+    Van ADD_NODE handshake, collapsed into one call). Idempotent: a second
+    call (e.g. explicit init_from_env followed by adapm_tpu.setup) is a
+    no-op, like the reference's Postoffice::Start start_stage_ guard."""
     coord = os.environ.get(ENV_COORD)
     if not coord:
         return False
@@ -40,6 +42,9 @@ def init_from_env() -> bool:
     pid = int(os.environ[ENV_PROC_ID])
     if n <= 1:
         return False
+    from jax._src import distributed
+    if distributed.global_state.client is not None:
+        return True  # already joined
     import jax
     jax.distributed.initialize(coordinator_address=coord, num_processes=n,
                                process_id=pid)
@@ -56,11 +61,26 @@ def process_id() -> int:
     return jax.process_index()
 
 
+_barrier_seq = 0
+
+
 def barrier(name: str = "adapm") -> None:
     """Global process barrier (reference Postoffice::Barrier via the
-    scheduler, src/postoffice.cc:149-174)."""
+    scheduler, src/postoffice.cc:149-174). Rides the coordinator's gRPC
+    barrier — no device collectives, so it is safe to call from planner /
+    background threads while device programs are in flight."""
     import jax
     if jax.process_count() == 1:
+        return
+    global _barrier_seq
+    from jax._src import distributed
+    client = distributed.global_state.client
+    if client is not None:
+        # every process must use the same sequence of barrier ids; callers
+        # are required to barrier in the same order on all processes (the
+        # reference's scheduler counts BARRIER messages the same way)
+        _barrier_seq += 1
+        client.wait_at_barrier(f"adapm/{name}/{_barrier_seq}", 120_000)
         return
     from jax.experimental import multihost_utils
     multihost_utils.sync_global_devices(name)

@@ -68,6 +68,9 @@ class DcnChannel:
         self._listener: Optional[socket.socket] = None
         self._peers: Dict[int, socket.socket] = {}
         self._peer_locks: Dict[int, threading.Lock] = {}
+        # guards _peers/_peer_locks mutation: two threads making first
+        # requests to the same peer must agree on one (socket, lock) pair
+        self._resolve_lock = threading.Lock()
         self._threads = []
         self._stop = threading.Event()
 
@@ -90,18 +93,19 @@ class DcnChannel:
         self._threads.append(t)
 
     def _resolve(self, peer: int) -> socket.socket:
-        sock = self._peers.get(peer)
-        if sock is not None:
+        with self._resolve_lock:
+            sock = self._peers.get(peer)
+            if sock is not None:
+                return sock
+            from jax._src import distributed
+            client = distributed.global_state.client
+            addr = client.blocking_key_value_get(f"adapm/dcn/{peer}", 60_000)
+            host, port = addr.rsplit(":", 1)
+            sock = socket.create_connection((host, int(port)), timeout=60)
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._peers[peer] = sock
+            self._peer_locks[peer] = threading.Lock()
             return sock
-        from jax._src import distributed
-        client = distributed.global_state.client
-        addr = client.blocking_key_value_get(f"adapm/dcn/{peer}", 60_000)
-        host, port = addr.rsplit(":", 1)
-        sock = socket.create_connection((host, int(port)), timeout=60)
-        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        self._peers[peer] = sock
-        self._peer_locks[peer] = threading.Lock()
-        return sock
 
     def _accept_loop(self) -> None:
         while not self._stop.is_set():

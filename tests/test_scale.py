@@ -17,7 +17,9 @@ def test_million_key_server_constructs_fast():
     srv = adapm_tpu.setup(1_000_000, 8, opts=SystemOptions(
         sync_max_per_sec=0, cache_slots_per_shard=1024))
     dt = time.perf_counter() - t0
-    assert dt < 3.0, f"1M-key construction took {dt:.2f}s"
+    # generous bound: catches an accidental per-key Python loop (minutes at
+    # 1M keys) without flaking on a loaded CI host
+    assert dt < 30.0, f"1M-key construction took {dt:.2f}s"
     # spot-check the vectorized initial allocation: home = k % S, slots
     # contiguous per (class, shard)
     ab = srv.ab
@@ -51,7 +53,8 @@ def test_large_intent_batch_vectorized():
     t0 = time.perf_counter()
     srv.wait_sync()
     dt = time.perf_counter() - t0
-    assert dt < 10.0, f"10k-key intent drain took {dt:.2f}s"
+    # generous bound: a per-key drain would take minutes (see above)
+    assert dt < 60.0, f"10k-key intent drain took {dt:.2f}s"
     assert srv.sync.stats.relocations > 0, "exclusive intents should relocate"
     assert srv.ab.is_local(keys, w0.shard).all()
     # phase 2: competing intent on keys now owned by shard 0 -> replication

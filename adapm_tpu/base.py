@@ -22,6 +22,7 @@ LOCAL = -1                            # op timestamp: answered entirely locally
 # Addressbook sentinels
 NOT_CACHED = -2                       # location cache: no cached location
 NO_SLOT = -1                          # key has no slot in a pool
+REMOTE = -1                           # owner: main copy lives on another process
 
 
 def check_key_range(keys, num_keys: int, what: str = "key") -> None:

@@ -28,7 +28,7 @@ from typing import List, Sequence
 
 import numpy as np
 
-from ..base import NO_SLOT
+from ..base import NO_SLOT, REMOTE
 
 
 class SlotAllocator:
@@ -109,16 +109,28 @@ class SlotAllocator:
 
 
 class Addressbook:
-    """Global key → location tables over all length classes."""
+    """Global key → location tables over all length classes.
+
+    Multi-process (num_procs > 1): the key space is partitioned over
+    `num_procs * num_shards` *global* shards; this process's tables cover
+    only the keys whose global home shard lands here. Keys owned by another
+    process carry `owner == REMOTE` (and no slot) — the cross-process layer
+    (parallel/pm.py GlobalPM) routes those, mirroring the reference split
+    between the per-node store and the manager/owner metadata
+    (addressbook.h:110-151)."""
 
     def __init__(self, key_class: np.ndarray, num_shards: int,
-                 main_slots: Sequence[int], cache_slots: Sequence[int]):
+                 main_slots: Sequence[int], cache_slots: Sequence[int],
+                 num_procs: int = 1, pid: int = 0):
         num_keys = len(key_class)
         self.num_keys = num_keys
         self.num_shards = num_shards
+        self.num_procs = num_procs
+        self.pid = pid
         self.key_class = key_class.astype(np.int32)
-        # main copy location: owner shard + slot within the class pool
-        self.owner = np.empty(num_keys, dtype=np.int32)
+        # main copy location: owner shard + slot within the class pool;
+        # REMOTE = owned by another process
+        self.owner = np.full(num_keys, REMOTE, dtype=np.int32)
         self.slot = np.full(num_keys, NO_SLOT, dtype=np.int32)
         # replica locations: cache_slot[shard, key] = class-pool cache slot
         self.cache_slot = np.full((num_shards, num_keys), NO_SLOT,
@@ -131,23 +143,32 @@ class Addressbook:
         self.main_alloc = [SlotAllocator(num_shards, m) for m in main_slots]
         self.cache_alloc = [SlotAllocator(num_shards, c) for c in cache_slots]
 
-        # initial allocation, vectorized: home shard = key % S
-        # (addressbook.h:110-112); within (class, shard) keys take
-        # consecutive slots in key order
+        # initial allocation, vectorized: global home shard = key % (S*P)
+        # (reference manager = key % num_servers, addressbook.h:110-112);
+        # within (class, local shard) keys take consecutive slots in key order
+        gs = num_shards * num_procs
         single_class = len(self.main_alloc) == 1
         for cid, alloc in enumerate(self.main_alloc):
             if single_class:
-                # fast path (uniform value lengths, the common case): for the
-                # contiguous key range, rank within the home group is k // S
-                home = (np.arange(num_keys) % num_shards).astype(np.int32)
-                self.owner[:] = home
-                self.slot[:] = np.arange(num_keys) // num_shards
-                alloc.set_watermark(np.bincount(home, minlength=num_shards))
+                # fast path (uniform value lengths, the common case): keys
+                # with the same global home shard are k ≡ g (mod S*P), so
+                # the rank within the group is k // (S*P)
+                g = np.arange(num_keys) % gs
+                owned = (g // num_shards) == pid
+                lsh = (g % num_shards).astype(np.int32)
+                self.owner[:] = np.where(owned, lsh, REMOTE)
+                self.slot[:] = np.where(owned, np.arange(num_keys) // gs,
+                                        NO_SLOT)
+                alloc.set_watermark(
+                    np.bincount(lsh[owned], minlength=num_shards))
                 continue
             keys_c = np.nonzero(self.key_class == cid)[0]
+            g = keys_c % gs
+            keys_c = keys_c[(g // num_shards) == pid]
             if len(keys_c) == 0:
+                alloc.set_watermark(np.zeros(num_shards, dtype=np.int64))
                 continue
-            home = (keys_c % num_shards).astype(np.int32)
+            home = ((keys_c % gs) % num_shards).astype(np.int32)
             counts = np.zeros(num_shards, dtype=np.int64)
             for h in range(num_shards):  # S masked passes beat an argsort
                 grp = keys_c[home == h]
@@ -233,6 +254,49 @@ class Addressbook:
         alloc.free(old_shard, old_slot)
         self.relocation_counter[key] += 1
         return old_shard, old_slot, new_slot
+
+    def adopt_batch(self, keys: np.ndarray, shard: int) -> np.ndarray:
+        """Cross-process relocation, requester side: this process takes
+        ownership of `keys` (currently REMOTE, single class), placing their
+        main copies on local `shard`. Returns the allocated slots. Raises
+        if the main pool lacks capacity — pools are sized to hold every key
+        of the class (ShardedStore geometry), so exhaustion is a bug, not a
+        load condition (contrast relocate_batch's graceful truncation)."""
+        if len(keys) == 0:
+            return np.empty(0, dtype=np.int64)
+        assert (self.owner[keys] == REMOTE).all(), \
+            "adopt_batch keys must be remotely owned"
+        cls = self.key_class[keys]
+        assert (cls == cls[0]).all(), "adopt_batch must be single-class"
+        alloc = self.main_alloc[int(cls[0])]
+        slots = alloc.alloc_batch(shard, len(keys))
+        if len(slots) < len(keys):
+            raise RuntimeError(
+                f"shard {shard} out of main pool slots while adopting "
+                f"{len(keys)} relocated keys (pool "
+                f"{alloc.slots_per_shard}); increase over_alloc")
+        self.owner[keys] = shard
+        self.slot[keys] = slots
+        self.relocation_counter[keys] += 1
+        return slots
+
+    def abandon_batch(self, keys: np.ndarray) -> None:
+        """Cross-process relocation, owner side: release ownership of
+        locally-owned `keys` (single class) — their main copies move to
+        another process. Frees the main slots; owner becomes REMOTE."""
+        if len(keys) == 0:
+            return
+        cls = self.key_class[keys]
+        assert (cls == cls[0]).all(), "abandon_batch must be single-class"
+        sh = self.owner[keys]
+        sl = self.slot[keys]
+        assert (sh >= 0).all(), "abandon_batch keys must be locally owned"
+        alloc = self.main_alloc[int(cls[0])]
+        for s in np.unique(sh):
+            alloc.free_batch(int(s), sl[sh == s])
+        self.owner[keys] = REMOTE
+        self.slot[keys] = NO_SLOT
+        self.relocation_counter[keys] += 1
 
     def relocate_batch(self, keys: np.ndarray, new_shard: int) -> tuple:
         """Move ownership of `keys` (single class, none already owned by

@@ -39,15 +39,18 @@ from .sync import SyncManager
 
 
 class _WaitEntry:
-    __slots__ = ("groups", "out", "is_write", "keys")
+    __slots__ = ("groups", "out", "is_write", "keys", "remote", "futures")
 
-    def __init__(self, groups=None, out=None, is_write=False, keys=None):
+    def __init__(self, groups=None, out=None, is_write=False, keys=None,
+                 remote=None, futures=None):
         # groups: list of (class_id, row_positions, key_lengths_slice,
         #                  device_vals, n)
         self.groups = groups or []
         self.out = out
         self.is_write = is_write  # push/set: wait = block on current pools
         self.keys = keys
+        self.remote = remote      # (positions, Future) for cross-process keys
+        self.futures = futures or []  # outstanding cross-process writes
 
 
 class Server:
@@ -85,6 +88,10 @@ class Server:
         key_class = np.searchsorted(uniq, self.value_lengths).astype(np.int32)
         class_counts = np.bincount(key_class, minlength=len(uniq))
 
+        from ..parallel import control
+        self.num_procs = control.num_processes()
+        self.pid = control.process_id()
+
         self.stores: List[ShardedStore] = []
         for cid, L in enumerate(self.class_lengths):
             self.stores.append(ShardedStore(
@@ -94,13 +101,17 @@ class Server:
         self.ab = Addressbook(
             key_class, self.ctx.num_shards,
             [s.main_slots for s in self.stores],
-            [s.cache_slots for s in self.stores])
+            [s.cache_slots for s in self.stores],
+            num_procs=self.num_procs, pid=self.pid)
 
         self.num_shards = self.ctx.num_shards
         self.max_workers = num_workers or max(self.num_shards, 1)
         self._workers: Dict[int, "Worker"] = {}
         self._clocks = np.zeros(self.max_workers, dtype=np.int64)
         self._lock = threading.RLock()
+        # serializes sync ROUNDS (planner) without holding _lock across DCN
+        # round-trips — see parallel/pm.py locking discipline
+        self._round_lock = threading.Lock()
         self._in_setup = False
         # bumped whenever placement changes (replica add/drop, relocation);
         # consumers (LocalSampling) use it to invalidate local-key caches
@@ -109,6 +120,13 @@ class Server:
         self.sync = SyncManager(self, self.opts)
         self._sync_thread: Optional[threading.Thread] = None
         self._sync_stop = threading.Event()
+
+        # cross-process layer: N launched processes form one PM
+        # (parallel/pm.py; reference van/postoffice data plane)
+        self.glob = None
+        if self.num_procs > 1:
+            from ..parallel.pm import GlobalPM
+            self.glob = GlobalPM(self)
 
         self.sampling = None  # set by enable_sampling_support
 
@@ -241,23 +259,103 @@ class Server:
 
     # -- core ops (called by Worker; all under the server lock) --------------
 
-    def _pull(self, keys: np.ndarray, shard: int):
-        """Returns (groups, n_remote): one gather per length class."""
+    def _pull(self, keys: np.ndarray, shard: int, after=()):
+        """Returns (groups, n_remote, remote): one gather per length class.
+        `remote` is (positions, Future) for process-remote keys served over
+        the DCN channel (multi-process only); `after` futures are this
+        worker's outstanding remote writes (read-your-writes ordering)."""
+        remote = None
+        loc_map = None
+        if self.glob is not None:
+            proc_rem = (self.ab.owner[keys] < 0) & \
+                (self.ab.cache_slot[shard, keys] < 0)
+            if proc_rem.any():
+                rem_pos = np.nonzero(proc_rem)[0]
+                fut = self.glob.pull_async(keys[rem_pos], after=after)
+                remote = (rem_pos, fut)
+                loc_map = np.nonzero(~proc_rem)[0]
+                keys = keys[loc_map]
         groups = []
-        n_remote = 0
+        n_remote = 0 if remote is None else len(remote[0])
+        if len(keys) == 0:
+            return groups, n_remote, remote
         for cid, pos in self._group_by_class(keys):
             ks = keys[pos]
             o_sh, o_sl, c_sh, c_sl, use_c, nr = self._route(ks, shard)
             n_remote += nr
             o_sl = np.where(use_c, OOB, o_sl).astype(np.int32)
             vals = self.stores[cid].gather(o_sh, o_sl, c_sh, c_sl, use_c)
-            groups.append((cid, pos, self.value_lengths[ks], vals, len(ks)))
-        return groups, n_remote
+            gpos = pos if loc_map is None else loc_map[pos]
+            groups.append((cid, gpos, self.value_lengths[ks], vals, len(ks)))
+        return groups, n_remote, remote
 
     def _push(self, keys: np.ndarray, vals: np.ndarray, shard: int,
-              is_set: bool = False) -> int:
+              is_set: bool = False, after=()):
+        """Returns (n_remote, futures): futures are outstanding cross-process
+        writes (multi-process only; `after` = the worker's earlier write
+        futures, chained to preserve per-worker write order)."""
         flat = vals.ndim == 1
         n_remote = 0
+        futures = []
+        if self.glob is not None:
+            # Set must reach the owner; Push may land in a local replica's
+            # delta row (same split as the reference's local attempt)
+            if is_set:
+                proc_rem = self.ab.owner[keys] < 0
+            else:
+                proc_rem = (self.ab.owner[keys] < 0) & \
+                    (self.ab.cache_slot[shard, keys] < 0)
+            if proc_rem.any():
+                from ..parallel.pm import _offsets, _select_flat
+                rem_pos = np.nonzero(proc_rem)[0]
+                rem_keys = keys[rem_pos]
+                if flat:
+                    lens = self.value_lengths[keys]
+                    rem_flat = _select_flat(vals, _offsets(lens), lens,
+                                            rem_pos)
+                else:
+                    rem_flat = np.ascontiguousarray(vals[rem_pos]).ravel()
+                chain = list(after)
+                if is_set:
+                    # Set invalidates any local replicas of these keys: a
+                    # kept replica's pending delta would re-add on top of
+                    # the overwritten value. Flush the delta (ordered
+                    # BEFORE the set) and drop the replica; reads route to
+                    # the owner afterwards.
+                    cs = self.ab.cache_slot[shard, rem_keys]
+                    has = cs >= 0
+                    if has.any():
+                        from ..parallel.pm import _fill_flat
+                        hk = np.unique(rem_keys[has])
+                        lens_h = self.value_lengths[hk]
+                        offs_h = _offsets(lens_h)
+                        dflat = np.zeros(offs_h[-1], np.float32)
+                        for cid, pos in self._group_by_class(hk):
+                            rows = self.stores[cid].read_rows(
+                                "delta",
+                                np.full(len(pos), shard, np.int32),
+                                self.ab.cache_slot[
+                                    shard, hk[pos]].astype(np.int32))
+                            _fill_flat(dflat, offs_h, lens_h, pos,
+                                       rows.ravel())
+                        self._drop_cross_replicas(hk, shard)
+                        chain = chain + [self.glob.write_async(
+                            hk, dflat, is_set=False, after=chain)]
+                fut = self.glob.write_async(
+                    rem_keys, rem_flat.astype(np.float32), is_set,
+                    after=chain)
+                if is_set and proc_rem.any() and len(chain) > len(after):
+                    # the owner keeps serving sync for our dropped replicas
+                    # until we unsubscribe; do it once the set has landed
+                    fut = self.glob.unsub_async(hk, after=[fut])
+                futures.append(fut)
+                n_remote += len(rem_pos)
+                loc_pos = np.nonzero(~proc_rem)[0]
+                if flat:
+                    vals = _select_flat(vals, _offsets(lens), lens, loc_pos)
+                else:
+                    vals = vals[loc_pos]
+                keys = keys[loc_pos]
         for cid, pos in self._group_by_class(keys):
             ks = keys[pos]
             L = self.class_lengths[cid]
@@ -276,7 +374,72 @@ class Server:
                 n_remote += nr
                 o_sl = np.where(use_c, OOB, o_sl).astype(np.int32)
                 self.stores[cid].scatter_add(o_sh, o_sl, c_sh, c_sl, rows)
-        return n_remote
+        return n_remote, futures
+
+    # -- cross-process service endpoints (called by GlobalPM under _lock) ----
+
+    def _read_owned_flat(self, keys: np.ndarray) -> np.ndarray:
+        """Current main-copy values of locally-owned keys (flat concat)."""
+        groups, _ = self._pull_main_only(keys)
+        return self._assemble_flat(keys, groups)
+
+    def _apply_remote_write(self, keys: np.ndarray, flat: np.ndarray,
+                            is_set: bool) -> None:
+        """Apply a cross-process push/set to locally-owned main rows."""
+        flat = np.asarray(flat, dtype=np.float32)
+        for cid, pos in self._group_by_class(keys):
+            ks = keys[pos]
+            L = self.class_lengths[cid]
+            rows = self._flat_parts(keys, flat, pos, L)
+            o_sh = self.ab.owner[ks].astype(np.int32)
+            o_sl = self.ab.slot[ks].astype(np.int32)
+            n = len(ks)
+            zeros = np.zeros(n, np.int32)
+            oob = np.full(n, OOB, np.int32)
+            if is_set:
+                self.stores[cid].set_rows(o_sh, o_sl, rows, zeros, oob)
+            else:
+                self.stores[cid].scatter_add(o_sh, o_sl, zeros, oob, rows)
+
+    def _drop_cross_replicas(self, keys: np.ndarray, shard: int) -> None:
+        """Drop this shard's replicas of remotely-owned `keys` (metadata +
+        channel registry only; the caller handles delta flushing and the
+        owner unsubscription). Caller holds the lock."""
+        from .sync import key_channel
+        keys = keys[self.ab.cache_slot[shard, keys] >= 0]
+        if len(keys) == 0:
+            return
+        chans = key_channel(keys, self.sync.num_channels)
+        for k, c in zip(keys.tolist(), chans.tolist()):
+            self.sync.replicas[c].discard((int(k), shard))
+        for _, pos in self._group_by_class(keys):
+            self.ab.drop_replicas(keys[pos], shard)
+        self.sync.stats.replicas_dropped += len(keys)
+        self.topology_version += 1
+
+    def _flush_drop_local_replicas(self, keys: np.ndarray) -> None:
+        """Flush pending deltas of all local replicas of `keys` into their
+        local main copies and drop the replicas (used before a forced
+        cross-process relocation so no delta is lost)."""
+        from .sync import key_channel
+        items = []
+        for s in range(self.num_shards):
+            for k in keys[self.ab.cache_slot[s, keys] >= 0].tolist():
+                items.append((int(k), s))
+        if not items:
+            return
+        self._sync_replicas(items)
+        karr = np.fromiter((k for k, _ in items), np.int64, len(items))
+        sarr = np.fromiter((s for _, s in items), np.int32, len(items))
+        chans = key_channel(karr, self.sync.num_channels)
+        for (k, s), c in zip(items, chans.tolist()):
+            self.sync.replicas[c].discard((k, s))
+        for s in np.unique(sarr):
+            sk = karr[sarr == s]
+            for _, pos in self._group_by_class(sk):
+                self.ab.drop_replicas(sk[pos], int(s))
+        self.sync.stats.replicas_dropped += len(items)
+        self.topology_version += 1
 
     # -- planner ops (called by SyncManager) ---------------------------------
 
@@ -290,6 +453,9 @@ class Server:
             ab = self.ab
             mask = ~ab.is_local(keys, shard)
             todo = np.unique(keys[mask])
+            # replica_create copies from LOCAL main rows; keys a DCN handler
+            # relocated away concurrently must not be materialized from them
+            todo = todo[ab.owner[todo] >= 0]
             if len(todo) == 0:
                 return np.empty(0, dtype=np.int64)
             created = []
@@ -327,17 +493,34 @@ class Server:
                 r_cs = ab.cache_slot[ss, ks].astype(np.int32)
                 o_sh = ab.owner[ks].astype(np.int32)
                 o_sl = ab.slot[ks].astype(np.int32)
+                # a DCN handler may have dropped a replica or relocated a
+                # key away since the caller snapshotted its items; a -1
+                # index would WRAP in the device gather/scatter and corrupt
+                # unrelated rows, so re-validate under the lock
+                ok = (r_cs >= 0) & (o_sl >= 0)
+                if not ok.all():
+                    ss, r_cs = ss[ok], r_cs[ok]
+                    o_sh, o_sl = o_sh[ok], o_sl[ok]
+                    if not ok.any():
+                        continue
                 self.stores[cid].sync_replicas(ss, r_cs, o_sh, o_sl,
                                                threshold=threshold)
 
     def _drop_replicas(self, items: List[Tuple[int, int]]) -> None:
         with self._lock:
+            # drop only replicas still on record (a DCN handler may have
+            # upgraded/dropped some since the caller snapshotted)
+            karr = np.fromiter((k for k, _ in items), np.int64, len(items))
+            sarr = np.fromiter((s for _, s in items), np.int32, len(items))
+            ok = self.ab.cache_slot[sarr, karr] >= 0
+            items = [it for it, m in zip(items, ok) if m]
+            if not items:
+                return
+            karr, sarr = karr[ok], sarr[ok]
             # flush pending deltas first (base refresh is harmless), then
             # free the slots (reference readAndPotentiallyDropReplica) —
             # grouped per (shard, class), not per key
             self._sync_replicas(items)
-            karr = np.fromiter((k for k, _ in items), np.int64, len(items))
-            sarr = np.fromiter((s for _, s in items), np.int32, len(items))
             for s in np.unique(sarr):
                 sk = karr[sarr == s]
                 for _, pos in self._group_by_class(sk):
@@ -371,9 +554,12 @@ class Server:
             ab = self.ab
             # dedup: a duplicate key would double-free its old main slot in
             # relocate_batch (the drain path dedups in Worker.intent, but
-            # direct callers may not)
+            # direct callers may not). Keys a DCN handler relocated to
+            # another PROCESS since the caller's classification are skipped
+            # (owner < 0): the planner re-requests them cross-process on a
+            # later intent drain.
             keys = np.unique(keys)
-            keys = keys[ab.owner[keys] != dest]
+            keys = keys[(ab.owner[keys] != dest) & (ab.owner[keys] >= 0)]
             if len(keys) == 0:
                 return 0
             for cid, pos in self._group_by_class(keys):
@@ -429,7 +615,8 @@ class Server:
             last_report = _time.monotonic()
             last_rounds = 0
             while not self._sync_stop.is_set():
-                self.sync.run_round()
+                with self._round_lock:
+                    self.sync.run_round()
                 # periodic report (reference SyncManager 10-second reports,
                 # sync_manager.h:482-497)
                 rs = self.opts.sync_report_s
@@ -481,6 +668,8 @@ class Server:
         self.stop_sync_thread()
         self.block()
         self.write_stats()
+        if self.glob is not None:
+            self.glob.shutdown()
 
     def locality_summary(self) -> Dict[str, float]:
         """Aggregate worker op/param locality ratios (reference shutdown
@@ -519,21 +708,42 @@ class Server:
 
     def wait_sync(self) -> None:
         """Act on all signalled intents and complete a full sync round
-        (reference WaitSync, coloc_kv_worker.h:517)."""
-        with self._lock:
+        (reference WaitSync, coloc_kv_worker.h:517). Multi-process: the
+        round ships cross-process deltas and intent requests; the full
+        quiesce protocol is WaitSync -> Barrier -> WaitSync on every
+        process (reference test_many_key_operations.cc:375-385)."""
+        with self._round_lock:
             self.sync.run_round(force_intents=True, all_channels=True)
         self.block()
 
     def quiesce(self) -> None:
-        with self._lock:
+        with self._round_lock:
             self.sync.quiesce()
 
     def read_main(self, keys) -> np.ndarray:
-        """Debug/test: read current main-copy values (flat concat)."""
+        """Debug/test/checkpoint: read current authoritative main-copy
+        values (flat concat). Multi-process: remotely-owned keys are read
+        from their owner over the DCN channel."""
         keys = np.asarray(keys, dtype=np.int64)
+        if self.glob is None:
+            with self._lock:
+                groups, _ = self._pull_main_only(keys)
+            return self._assemble_flat(keys, groups)
+        from ..parallel.pm import _fill_flat, _offsets
+        lens = self.value_lengths[keys]
+        offs = _offsets(lens)
+        out = np.empty(offs[-1], dtype=np.float32)
         with self._lock:
-            groups, _ = self._pull_main_only(keys)
-        return self._assemble_flat(keys, groups)
+            owned = self.ab.owner[keys] >= 0
+            pos = np.nonzero(owned)[0]
+            if len(pos):
+                _fill_flat(out, offs, lens, pos,
+                           self._read_owned_flat(keys[pos]))
+        rem = np.nonzero(~owned)[0]
+        if len(rem):
+            flat_r, _ = self.glob.request_pull(keys[rem])
+            _fill_flat(out, offs, lens, rem, flat_r)
+        return out
 
     def _pull_main_only(self, keys: np.ndarray):
         ab = self.ab
@@ -549,7 +759,8 @@ class Server:
             groups.append((cid, pos, self.value_lengths[ks], vals, n))
         return groups, 0
 
-    def _assemble_flat(self, keys: np.ndarray, groups) -> np.ndarray:
+    def _assemble_flat(self, keys: np.ndarray, groups,
+                       remote=None) -> np.ndarray:
         total = int(self.val_offsets[keys + 1].sum()
                     - self.val_offsets[keys].sum())
         out = np.empty(total, dtype=np.float32)
@@ -557,11 +768,20 @@ class Server:
         lens = self.value_lengths[keys]
         offs = np.zeros(len(keys) + 1, dtype=np.int64)
         np.cumsum(lens, out=offs[1:])
+        uniform = len(self.class_lengths) == 1
         for cid, pos, klens, vals, n in groups:
             host = np.asarray(vals)[:n]
             L = self.class_lengths[cid]
+            if uniform:
+                # single length class: one strided write, not a per-key loop
+                out.reshape(-1, L)[pos] = host
+                continue
             for i, p in enumerate(pos):
                 out[offs[p]:offs[p] + L] = host[i]
+        if remote is not None:
+            from ..parallel.pm import _fill_flat
+            rem_pos, fut = remote
+            _fill_flat(out, offs, lens, rem_pos, fut.result())
         return out
 
 
@@ -581,6 +801,9 @@ class Worker:
         self._pending: Dict[int, _WaitEntry] = {}
         from .intent import IntentQueue
         self._intent_queue = IntentQueue()
+        # outstanding cross-process write futures (read-your-writes: remote
+        # pulls are ordered after them, see Server._pull's `after`)
+        self._write_futs: List = []
         # locality stats (reference coloc_kv_server.h:147-157)
         self.stats = {"pull_ops": 0, "pull_ops_local": 0,
                       "pull_params": 0, "pull_params_local": 0,
@@ -599,18 +822,24 @@ class Worker:
 
     # -- API: Pull / Push / Set ----------------------------------------------
 
+    def _live_write_futs(self):
+        self._write_futs = [f for f in self._write_futs if not f.done()]
+        return list(self._write_futs)
+
     def pull(self, keys, out: Optional[np.ndarray] = None) -> int:
         """Async pull. Returns ts (use wait) or LOCAL=-1 if every key was
         served from this worker's shard (owned or replicated) — in that case
         `out` is already filled when provided."""
         keys = self._keys(keys)
         srv = self.server
+        after = self._live_write_futs() if srv.glob is not None else ()
         with srv._lock:
-            groups, n_remote = srv._pull(keys, self.shard)
+            groups, n_remote, remote = srv._pull(keys, self.shard,
+                                                 after=after)
         self.stats["pull_ops"] += 1
         self.stats["pull_params"] += len(keys)
         self.stats["pull_params_local"] += len(keys) - n_remote
-        entry = _WaitEntry(groups=groups, out=out, keys=keys)
+        entry = _WaitEntry(groups=groups, out=out, keys=keys, remote=remote)
         if n_remote == 0:
             self.stats["pull_ops_local"] += 1
             self._finish_pull(keys, entry)
@@ -632,7 +861,8 @@ class Worker:
         return flat
 
     def _finish_pull(self, keys, entry: _WaitEntry) -> np.ndarray:
-        flat = self.server._assemble_flat(keys, entry.groups)
+        flat = self.server._assemble_flat(keys, entry.groups,
+                                          remote=entry.remote)
         if entry.out is not None:
             np.copyto(entry.out.reshape(-1)[: len(flat)], flat)
         self._last_result = flat
@@ -646,7 +876,7 @@ class Worker:
         with srv._lock:
             if not bool(srv.ab.is_local(keys, self.shard).all()):
                 return False, None
-            groups, _ = srv._pull(keys, self.shard)
+            groups, _, _ = srv._pull(keys, self.shard)
         entry = _WaitEntry(groups=groups, out=out)
         return True, self._finish_pull(keys, entry)
 
@@ -656,15 +886,18 @@ class Worker:
         keys = self._keys(keys)
         vals = np.asarray(vals, dtype=np.float32)
         srv = self.server
+        after = self._live_write_futs() if srv.glob is not None else ()
         with srv._lock:
-            n_remote = srv._push(keys, vals, self.shard, is_set=False)
+            n_remote, futs = srv._push(keys, vals, self.shard,
+                                       is_set=False, after=after)
         self.stats["push_ops"] += 1
         self.stats["push_params"] += len(keys)
         self.stats["push_params_local"] += len(keys) - n_remote
+        self._write_futs.extend(futs)
         if n_remote == 0:
             self.stats["push_ops_local"] += 1
             return LOCAL
-        return self._new_ts(_WaitEntry(is_write=True))
+        return self._new_ts(_WaitEntry(is_write=True, futures=futs))
 
     def staggered_push(self, keys, vals, group_size: int = 100_000) -> int:
         """Push a large key set in groups (reference StaggeredPush,
@@ -688,11 +921,14 @@ class Worker:
         keys = self._keys(keys)
         vals = np.asarray(vals, dtype=np.float32)
         srv = self.server
+        after = self._live_write_futs() if srv.glob is not None else ()
         with srv._lock:
-            n_remote = srv._push(keys, vals, self.shard, is_set=True)
+            n_remote, futs = srv._push(keys, vals, self.shard,
+                                       is_set=True, after=after)
+        self._write_futs.extend(futs)
         if n_remote == 0:
             return LOCAL
-        return self._new_ts(_WaitEntry(is_write=True))
+        return self._new_ts(_WaitEntry(is_write=True, futures=futs))
 
     # -- API: waiting ---------------------------------------------------------
 
@@ -703,10 +939,13 @@ class Worker:
         entry = self._pending.pop(ts, None)
         if entry is None:
             return None
-        if entry.groups:
+        if entry.groups or entry.remote is not None:
             return self._finish_pull(entry.keys, entry)
         # write op: dispatch order serializes programs on the pool buffers,
-        # so blocking on the current pools covers this op
+        # so blocking on the current pools covers this op; cross-process
+        # writes complete when their futures resolve
+        for f in entry.futures:
+            f.result()
         self.server.block()
         return None
 
@@ -719,9 +958,14 @@ class Worker:
         if ts == LOCAL or ts not in self._pending:
             return True
         entry = self._pending[ts]
+        if not all(f.done() for f in entry.futures):
+            return False
+        if entry.remote is not None and not entry.remote[1].done():
+            return False
         if entry.is_write:
             with self.server._lock:
-                return all(s.main.is_ready() for s in self.server.stores)
+                return all(s.main.is_ready() and s.delta.is_ready()
+                           for s in self.server.stores)
         return all(g[3].is_ready() for g in entry.groups)
 
     def wait_sync(self) -> None:

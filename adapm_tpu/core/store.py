@@ -135,6 +135,34 @@ def _sync_replicas_thresholded(main, cache, delta, r_shard, r_cslot,
     return main, cache, delta
 
 
+@jax.jit
+def _read_rows_at(arr, sh, sl):
+    return arr.at[sh, sl].get(mode="fill", fill_value=0)
+
+
+@partial(jax.jit, donate_argnums=(0, 1))
+def _install_rows(cache, delta, c_shard, c_slot, vals):
+    """Install replica base rows received from a remote owner: set the base,
+    zero the pending delta (cross-process replica creation; the local-owner
+    twin is _replica_create)."""
+    cache = cache.at[c_shard, c_slot].set(vals, mode="drop")
+    delta = delta.at[c_shard, c_slot].set(jnp.zeros_like(vals), mode="drop")
+    return cache, delta
+
+
+@partial(jax.jit, donate_argnums=(0, 1))
+def _refresh_after_sync(cache, delta, c_shard, c_slot, fresh, shipped):
+    """Finish a cross-process sync round: install the owner's fresh value as
+    the new base and subtract exactly the shipped delta (pushes that landed
+    between extraction and refresh stay pending). Readers see base+delta
+    throughout, so a local value never dips below what this worker already
+    pushed — the moral equivalent of the reference keeping `val` intact and
+    only advancing `sync_state` (handle.h:601-662)."""
+    cache = cache.at[c_shard, c_slot].set(fresh, mode="drop")
+    delta = delta.at[c_shard, c_slot].add(-shipped, mode="drop")
+    return cache, delta
+
+
 @partial(jax.jit, donate_argnums=(0, 1))
 def _relocate(main, delta, old_shard, old_slot, new_shard, new_slot,
               rc_shard, rc_slot):
@@ -235,6 +263,34 @@ class ShardedStore:
                        (new_slot, OOB), (rc_shard, 0), (rc_slot, OOB),
                        minimum=self.bucket_min)
         self.main, self.delta = _relocate(self.main, self.delta, *a)
+
+    # -- cross-process helpers (parallel/pm.py GlobalPM) ---------------------
+
+    def read_rows(self, which: str, sh, sl) -> np.ndarray:
+        """Host readback of pool rows (non-destructive). `which` selects the
+        pool; padding rows are dropped from the result."""
+        n = len(sh)
+        a = pad_bucket(n, (sh, 0), (sl, OOB), minimum=self.bucket_min)
+        arr = {"main": self.main, "cache": self.cache,
+               "delta": self.delta}[which]
+        return np.asarray(_read_rows_at(arr, *a))[:n]
+
+    def install_replica_rows(self, c_shard, c_slot, vals) -> None:
+        n = len(c_shard)
+        a = pad_bucket(n, (c_shard, 0), (c_slot, OOB),
+                       minimum=self.bucket_min)
+        v = self._vals_bucket(vals, a[0].shape[0])
+        self.cache, self.delta = _install_rows(self.cache, self.delta,
+                                               *a, v)
+
+    def refresh_after_sync(self, c_shard, c_slot, fresh, shipped) -> None:
+        n = len(c_shard)
+        a = pad_bucket(n, (c_shard, 0), (c_slot, OOB),
+                       minimum=self.bucket_min)
+        b = a[0].shape[0]
+        self.cache, self.delta = _refresh_after_sync(
+            self.cache, self.delta, *a,
+            self._vals_bucket(fresh, b), self._vals_bucket(shipped, b))
 
     def block(self) -> None:
         jax.block_until_ready((self.main, self.cache, self.delta))

@@ -94,9 +94,14 @@ class SyncManager:
                 # actions are applied per intent entry: a later intent in the
                 # same drain must observe placement changes made by earlier
                 # ones, or locality decisions go stale
-                relocate_keys, replicate_keys = self._register(
+                relocate_keys, replicate_keys, remote_keys = self._register(
                     w.shard, keys, end)
                 self.stats.intents_processed += len(keys)
+                if len(remote_keys):
+                    # keys owned by another process: the OWNER decides
+                    # relocate-vs-replicate (reference owner branch,
+                    # sync_manager.h:553-739) — ask it over the channel
+                    self.server.glob.intent_remote(remote_keys, w.shard, end)
                 if len(relocate_keys):
                     self.stats.relocations += self.server._relocate_to(
                         relocate_keys, w.shard)
@@ -104,18 +109,20 @@ class SyncManager:
                     created = self.server._create_replicas(
                         replicate_keys, w.shard)
                     chans = key_channel(created, self.num_channels)
-                    for k, c in zip(created.tolist(), chans.tolist()):
-                        self.replicas[c].add((k, w.shard))
+                    with self.server._lock:
+                        for k, c in zip(created.tolist(), chans.tolist()):
+                            self.replicas[c].add((k, w.shard))
                     self.stats.replicas_created += len(created)
 
     def _register(self, shard: int, keys: np.ndarray,
-                  end: int) -> Tuple[np.ndarray, np.ndarray]:
+                  end: int) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
         """Register an intent batch; returns (keys to relocate to `shard`,
-        keys to replicate onto `shard`). Fully vectorized — no per-key
-        Python (the reference is O(1)/key in C++, addressbook.h:110-151).
-        Capacity degradation (full pools) is handled downstream: _relocate
-        demotes to replication, _create_replicas truncates — slower for the
-        surplus keys, never wrong."""
+        keys to replicate onto `shard`, remotely-owned keys to hand to the
+        cross-process layer). Fully vectorized — no per-key Python (the
+        reference is O(1)/key in C++, addressbook.h:110-151). Capacity
+        degradation (full pools) is handled downstream: _relocate demotes
+        to replication, _create_replicas truncates — slower for the surplus
+        keys, never wrong."""
         ie = self.intent_end
         # validate up front so the native and numpy paths leave identical
         # intent_end state when the batch contains a bad key (the C helper
@@ -133,11 +140,17 @@ class SyncManager:
             self.server.tracer.record(keys, INTENT_START, shard)
         # keys that are not yet available on `shard`
         cand = keys[~self.server.ab.is_local(keys, shard)]
+        e = np.empty(0, dtype=np.int64)
         if len(cand) == 0:
-            e = np.empty(0, dtype=np.int64)
-            return e, e
+            return e, e, e
+        remote = e
+        if self.server.glob is not None:
+            rm = self.server.ab.owner[cand] < 0
+            remote, cand = cand[rm], cand[~rm]
+            if len(cand) == 0:
+                return e, e, remote
         relocate = self._decide_batch(cand, shard)
-        return cand[relocate], cand[~relocate]
+        return cand[relocate], cand[~relocate], remote
 
     def _decide_batch(self, keys: np.ndarray, shard: int) -> np.ndarray:
         """Relocate vs replicate (reference sync_manager.h:624-644): relocate
@@ -168,40 +181,59 @@ class SyncManager:
 
     def sync_channel(self, channel: int) -> None:
         """Refresh replicas with active intent; flush+drop expired ones
-        (reference readAndPotentiallyDropReplica, handle.h:601-662)."""
+        (reference readAndPotentiallyDropReplica, handle.h:601-662).
+        Replicas of remotely-owned keys sync/drop over the DCN channel."""
         reps = self.replicas[channel]
-        if not reps:
-            return
-        min_clocks = self.server.shard_min_clocks()
-        items = list(reps)
-        if self.server._native is not None:
+        srv = self.server
+        with srv._lock:  # cross-process handlers mutate replica sets too
+            if not reps:
+                return
+            items = list(reps)
+            cross_mask = (srv.ab.owner[np.fromiter(
+                (k for k, _ in items), np.int64, len(items))] < 0) \
+                if srv.glob is not None else None
+        min_clocks = srv.shard_min_clocks()
+        if srv._native is not None:
             karr = np.fromiter((k for k, _ in items), np.int64, len(items))
             sarr = np.fromiter((s for _, s in items), np.int32, len(items))
             keep_mask = np.empty(len(items), np.uint8)
-            self.server._native.adapm_replica_scan(
+            srv._native.adapm_replica_scan(
                 karr, sarr, len(items), self.intent_end.ravel(),
                 np.ascontiguousarray(min_clocks, np.int64),
-                self.server.num_keys, keep_mask)
+                srv.num_keys, keep_mask)
+        else:
+            keep_mask = np.fromiter(
+                (self.intent_end[s, k] >= min_clocks[s] for k, s in items),
+                np.uint8, len(items))
+        if cross_mask is None:
             keep = [it for it, m in zip(items, keep_mask) if m]
             drop = [it for it, m in zip(items, keep_mask) if not m]
+            keep_x = drop_x = []
         else:
-            keep = [(k, s) for k, s in items
-                    if self.intent_end[s, k] >= min_clocks[s]]
-            drop = [(k, s) for k, s in items
-                    if self.intent_end[s, k] < min_clocks[s]]
+            keep, drop, keep_x, drop_x = [], [], [], []
+            for it, m, x in zip(items, keep_mask, cross_mask):
+                (keep_x if x else keep).append(it) if m else \
+                    (drop_x if x else drop).append(it)
         if keep:
-            self.server._sync_replicas(
-                keep, threshold=self.opts.sync_threshold)
+            srv._sync_replicas(keep, threshold=self.opts.sync_threshold)
             self.stats.keys_synced += len(keep)
-        if drop:
-            if self.server.tracer is not None:
+        if keep_x:
+            srv.glob.sync_replicas(keep_x)
+            self.stats.keys_synced += len(keep_x)
+        if drop or drop_x:
+            if srv.tracer is not None:
                 from ..utils.stats import INTENT_STOP
-                for k, s in drop:
-                    self.server.tracer.record(k, INTENT_STOP, s)
-            self.server._drop_replicas(drop)
-            for item in drop:
-                reps.discard(item)
+                for k, s in drop + drop_x:
+                    srv.tracer.record(k, INTENT_STOP, s)
+        if drop:
+            srv._drop_replicas(drop)
+            with srv._lock:
+                for item in drop:
+                    reps.discard(item)
             self.stats.replicas_dropped += len(drop)
+        if drop_x:
+            srv.glob.drop_replicas(drop_x)  # discards from the channel set
+            self.stats.replicas_dropped += len(drop_x)
 
     def run_round(self, force_intents: bool = False,
                   all_channels: bool = False) -> None:
@@ -233,19 +265,38 @@ class SyncManager:
     # ------------------------------------------------------------------
 
     def quiesce(self) -> None:
-        """Force-process all intents and flush every pending delta; after this
-        all reads (from anywhere) observe identical values — the reference's
-        WaitSync + Barrier quiesce protocol (test_many_key_operations.cc)."""
+        """Force-process all intents and flush every pending delta; after
+        this — and in multi-process, after every process quiesces and a
+        barrier (WaitSync -> Barrier -> WaitSync) — all reads observe
+        identical values (reference test_many_key_operations.cc:375-385)."""
+        srv = self.server
         self.drain_intents(force=True)
         for c in range(self.num_channels):
-            reps = list(self.replicas[c])
-            if reps:
-                self.server._sync_replicas(reps)
-                self.stats.keys_synced += len(reps)
-        self.server.block()
+            with srv._lock:
+                reps = list(self.replicas[c])
+            if not reps:
+                continue
+            if srv.glob is not None:
+                karr = np.fromiter((k for k, _ in reps), np.int64, len(reps))
+                with srv._lock:
+                    cross = srv.ab.owner[karr] < 0
+                local = [it for it, x in zip(reps, cross) if not x]
+                remote = [it for it, x in zip(reps, cross) if x]
+            else:
+                local, remote = reps, []
+            if local:
+                srv._sync_replicas(local)
+                self.stats.keys_synced += len(local)
+            if remote:
+                srv.glob.sync_replicas(remote)
+                self.stats.keys_synced += len(remote)
+        srv.block()
 
     def report(self) -> str:
         s = self.stats
-        return (f"sync: rounds={s.rounds} intents={s.intents_processed} "
-                f"replicas+={s.replicas_created} -={s.replicas_dropped} "
-                f"relocations={s.relocations} keys_synced={s.keys_synced}")
+        out = (f"sync: rounds={s.rounds} intents={s.intents_processed} "
+               f"replicas+={s.replicas_created} -={s.replicas_dropped} "
+               f"relocations={s.relocations} keys_synced={s.keys_synced}")
+        if self.server.glob is not None:
+            out += " | " + self.server.glob.report()
+        return out

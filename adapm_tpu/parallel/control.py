@@ -62,13 +62,16 @@ def process_id() -> int:
 
 
 _barrier_seq = 0
+_barrier_lock = __import__("threading").Lock()
 
 
 def barrier(name: str = "adapm") -> None:
     """Global process barrier (reference Postoffice::Barrier via the
     scheduler, src/postoffice.cc:149-174). Rides the coordinator's gRPC
     barrier — no device collectives, so it is safe to call from planner /
-    background threads while device programs are in flight."""
+    background threads while device programs are in flight. Callers must
+    barrier in the same ORDER on every process (the reference's scheduler
+    counts BARRIER messages under the same contract)."""
     import jax
     if jax.process_count() == 1:
         return
@@ -76,11 +79,13 @@ def barrier(name: str = "adapm") -> None:
     from jax._src import distributed
     client = distributed.global_state.client
     if client is not None:
-        # every process must use the same sequence of barrier ids; callers
-        # are required to barrier in the same order on all processes (the
-        # reference's scheduler counts BARRIER messages the same way)
-        _barrier_seq += 1
-        client.wait_at_barrier(f"adapm/{name}/{_barrier_seq}", 120_000)
+        # id allocation is atomic; the wait happens outside the lock so
+        # concurrent barriers from different threads both make progress
+        with _barrier_lock:
+            _barrier_seq += 1
+            seq = _barrier_seq
+        # generous timeout: a peer may be inside a cold XLA compile
+        client.wait_at_barrier(f"adapm/{name}/{seq}", 600_000)
         return
     from jax.experimental import multihost_utils
     multihost_utils.sync_global_devices(name)

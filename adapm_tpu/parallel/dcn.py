@@ -142,7 +142,8 @@ class DcnChannel:
             reply = _recv_msg(sock)
         if reply is None:
             raise ConnectionError(f"peer {peer} closed the channel")
-        if isinstance(reply, tuple) and reply and reply[0] == "error":
+        if isinstance(reply, tuple) and reply \
+                and isinstance(reply[0], str) and reply[0] == "error":
             raise RuntimeError(f"peer {peer}: {reply[1]}")
         return reply
 

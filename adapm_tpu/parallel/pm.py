@@ -1,0 +1,803 @@
+"""GlobalPM: the cross-process parameter manager.
+
+This wires the DCN data channel (parallel/dcn.py) into the Server so that N
+launched processes form ONE parameter manager, the way the reference's nodes
+do (SURVEY.md §1):
+
+  - The key space is partitioned over `P * S_local` global shards
+    (Addressbook multi-process init); keys whose home lands on another
+    process carry `owner == REMOTE` locally.
+  - Pull/Push/Set of remotely-owned keys ride `DcnChannel.request` to the
+    owner process. Where the reference *forwards* server-side when the
+    target no longer owns a key (coloc_kv_server.h:455-476), here the
+    server replies with a redirect hint and the REQUESTER retries — same
+    number of network hops, but handler threads never issue nested
+    requests, so two processes serving each other can never deadlock on
+    their per-peer channel locks.
+  - Every reply carries the authoritative owner per served key, feeding
+    per-process **location caches** (reference addressbook.h:114-133;
+    `NOT_CACHED` sentinel; honored `--sys.location_caches`): with caches
+    on, the second access to a relocated key takes one hop; with caches
+    off, requests route via the key's manager (home process) every time.
+  - Intent on a remote key asks the owner to decide **relocate vs
+    replicate** (reference sync_manager.h:624-644): relocate iff no *other*
+    process and no owner-local worker holds interest; the owner tracks
+    interest as a per-key bitmask of subscribed processes (the reference's
+    per-sender node_intent sets, sync_manager.h:182, 571, 644).
+  - Ownership transfers carry **relocation counters**; the key's manager
+    accepts owner updates only with a newer counter, rejecting stale moves
+    (reference addressbook.h:92-102).
+  - Cross-process replicas live in the local cache/delta pools like local
+    ones; sync rounds extract delta rows, ship them to the owner, and
+    install the returned fresh value as the new base while subtracting
+    exactly the shipped delta — a local read observes base+delta
+    throughout, so a worker's own pushes never transiently vanish (the
+    reference keeps `val` intact and advances `sync_state`,
+    handle.h:601-662).
+
+Locking discipline: device/table mutations happen under `server._lock`;
+DCN round-trips NEVER happen while holding it (a peer's handler needs its
+own lock to serve us). Handler threads take only `server._lock` and issue
+no blocking requests (the manager notification is dispatched to the
+executor), so the request graph is acyclic.
+"""
+from __future__ import annotations
+
+import time
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..base import NOT_CACHED, MgmtTechniques
+from . import control
+from .dcn import DcnChannel
+
+# client-side redirect-retry budget: transient misses (a request racing an
+# ownership transfer) resolve within a hop or two once the adoption lands;
+# later tries back off to give it time
+MAX_TRIES = 64
+
+
+def _offsets(lens: np.ndarray) -> np.ndarray:
+    offs = np.zeros(len(lens) + 1, dtype=np.int64)
+    np.cumsum(lens, out=offs[1:])
+    return offs
+
+
+def _uniform(lens: np.ndarray) -> Optional[int]:
+    return int(lens[0]) if len(lens) and (lens == lens[0]).all() else None
+
+
+def _select_flat(flat: np.ndarray, offs: np.ndarray, lens: np.ndarray,
+                 pos: np.ndarray) -> np.ndarray:
+    """Extract the value segments of key positions `pos` from a flat concat
+    buffer over the full key batch."""
+    if len(pos) == 0:
+        return np.empty(0, dtype=np.float32)
+    u = _uniform(lens)
+    if u is not None:
+        return np.ascontiguousarray(flat.reshape(-1, u)[pos]).ravel()
+    return np.concatenate([flat[offs[p]:offs[p + 1]] for p in pos])
+
+
+def _fill_flat(out: np.ndarray, offs: np.ndarray, lens: np.ndarray,
+               pos: np.ndarray, part: np.ndarray) -> None:
+    """Write `part` (flat concat for positions `pos`) into the right
+    segments of `out` (flat concat for the full batch)."""
+    if len(pos) == 0:
+        return
+    u = _uniform(lens)
+    if u is not None:
+        out.reshape(-1, u)[pos] = part.reshape(len(pos), u)
+        return
+    poffs = _offsets(lens[pos])
+    for i, p in enumerate(pos):
+        out[offs[p]:offs[p] + lens[p]] = part[poffs[i]:poffs[i + 1]]
+
+
+class GlobalPM:
+    """One per Server when `jax.process_count() > 1`."""
+
+    def __init__(self, server):
+        self.server = server
+        self.pid = control.process_id()
+        self.num_procs = control.num_processes()
+        assert self.num_procs <= 64, \
+            "interest bitmask is uint64 (one bit per process)"
+        self._gs = server.num_shards * self.num_procs
+        K = server.num_keys
+
+        home = self.home_proc(np.arange(K, dtype=np.int64))
+        # owner_hint[k]: authoritative current owner for keys managed here
+        # (home == pid; maintained via counter-checked owner updates and our
+        # own transfers); elsewhere a location-cache hint, NOT_CACHED when
+        # caches are off or nothing has been learned yet
+        if server.opts.location_caches:
+            self.owner_hint = home.astype(np.int32)  # initially owner==home
+        else:
+            self.owner_hint = np.where(home == self.pid, home,
+                                       NOT_CACHED).astype(np.int32)
+        # dual-role relocation counters (reference addressbook.h:92-102):
+        # at the key's owner, the current counter (travels with ownership);
+        # at its manager, the newest counter seen (staleness filter)
+        self.reloc = np.zeros(K, dtype=np.int32)
+        # at the owner: bit p set = process p holds a replica of the key
+        self.interest = np.zeros(K, dtype=np.uint64)
+
+        self.stats = {"pulls_in": 0, "pushes_in": 0, "redirects": 0,
+                      "intents_in": 0, "relocations_out": 0,
+                      "relocations_in": 0, "replicas_granted": 0,
+                      "syncs_in": 0, "keys_synced_out": 0}
+
+        self.chan = DcnChannel(self.pid, self.num_procs, self._handle)
+        self.chan.start()
+        # separate pools: pull tasks may block on write futures, so writes
+        # must never queue behind blocked pulls
+        self._exec_r = ThreadPoolExecutor(max_workers=8,
+                                          thread_name_prefix="adapm-pm-r")
+        self._exec_w = ThreadPoolExecutor(max_workers=4,
+                                          thread_name_prefix="adapm-pm-w")
+        control.barrier("pm-up")
+
+    # -- partition helpers ---------------------------------------------------
+
+    def home_proc(self, keys: np.ndarray) -> np.ndarray:
+        """Manager process of each key: global home shard // S_local
+        (reference manager = key % num_servers, addressbook.h:110-112)."""
+        return (keys % self._gs) // self.server.num_shards
+
+    def _route_dest(self, keys: np.ndarray) -> np.ndarray:
+        """Best-known destination process per key: location hint if cached,
+        else the manager (which redirects to the owner it has on record).
+        dest == self is legitimate: a key may have been adopted locally
+        after the caller classified it as remote — _drive serves those
+        through the local handler, which owns the truth."""
+        hint = self.owner_hint[keys]
+        home = self.home_proc(keys)
+        return np.where(hint >= 0, hint, home).astype(np.int64)
+
+    def _learn(self, keys: np.ndarray, owners: np.ndarray) -> None:
+        """Update location caches from reply traffic (reference
+        addressbook.h:114-133, coloc_kv_worker.h:880-884). Manager entries
+        are authoritative and only move via counter-checked owner updates."""
+        if not self.server.opts.location_caches or len(keys) == 0:
+            return
+        mask = self.home_proc(keys) != self.pid
+        self.owner_hint[keys[mask]] = owners[mask]
+
+    def _hint_for(self, keys: np.ndarray) -> np.ndarray:
+        """Redirect hints for keys we do not own: our best owner knowledge
+        (authoritative for keys managed here), NOT_CACHED when unknown."""
+        h = self.owner_hint[keys].copy()
+        return np.where(h == self.pid, NOT_CACHED, h).astype(np.int32)
+
+    # -- the redirect-retry driver ------------------------------------------
+
+    def _drive(self, keys: np.ndarray,
+               make_msg: Callable[[np.ndarray, np.ndarray], tuple],
+               serve_local: Callable[[tuple], tuple],
+               merge: Callable[[tuple, np.ndarray], np.ndarray],
+               what: str) -> None:
+        """Send per-destination requests for `keys`, retrying unserved keys
+        at the redirect hint (or their manager). `make_msg(ks, pos)` builds
+        the request for a destination (pos = positions into `keys`);
+        `serve_local(msg)` handles the dest==self case; `merge(reply, pos)`
+        consumes a reply and returns the per-key owner/hint array (>= 0 and
+        served, or a hint/NOT_CACHED for unserved keys — unserved is
+        signaled by reply[0], the served mask)."""
+        pending = np.arange(len(keys), dtype=np.int64)
+        dest = self._route_dest(keys)
+        tries = 0
+        while len(pending):
+            tries += 1
+            if tries > MAX_TRIES:
+                raise RuntimeError(
+                    f"{what}: ownership metadata did not converge for keys "
+                    f"{keys[pending][:5].tolist()}...")
+            if tries > 2:
+                self.stats["redirects"] += len(pending)
+                time.sleep(min(0.002 * tries, 0.1))
+            still: List[np.ndarray] = []
+            for d in np.unique(dest[pending]):
+                pos = pending[dest[pending] == d]
+                msg = make_msg(keys[pos], pos)
+                reply = serve_local(msg) if d == self.pid \
+                    else self.chan.request(int(d), msg)
+                served = reply[0].astype(bool)
+                owners = merge(reply, pos)
+                self._learn(keys[pos][served], owners[served])
+                uns = pos[~served]
+                if len(uns):
+                    hint = owners[~served]
+                    home = self.home_proc(keys[uns])
+                    # hint == self means an adoption by our own planner is
+                    # in flight; keep routing to the local handler until it
+                    # lands (the retry backoff gives it time)
+                    dest[uns] = np.where(hint >= 0, hint, home)
+                    still.append(uns)
+            pending = np.concatenate(still) if still \
+                else np.empty(0, dtype=np.int64)
+
+    # -- inbound dispatch ----------------------------------------------------
+
+    def _handle(self, msg):
+        op = msg[0]
+        if op == "pull":
+            return self._serve_pull(msg)
+        if op in ("push", "set"):
+            return self._serve_write(msg)
+        if op == "intent":
+            return self._serve_intent(msg)
+        if op == "sync":
+            return self._serve_sync(msg)
+        if op == "unsub":
+            return self._serve_unsub(msg)
+        if op == "owner_update":
+            return self._serve_owner_update(msg)
+        raise ValueError(f"unknown DCN op {op!r}")
+
+    # -- pull ---------------------------------------------------------------
+
+    def _serve_pull(self, msg):
+        """Serve the keys we own; hint the rest. Reply:
+        (served u8[n], vals f32 flat[n], owners i32[n])."""
+        _, keys = msg
+        srv = self.server
+        keys = np.asarray(keys, dtype=np.int64)
+        lens = srv.value_lengths[keys]
+        offs = _offsets(lens)
+        out = np.zeros(offs[-1], dtype=np.float32)
+        owners = np.empty(len(keys), dtype=np.int32)
+        self.stats["pulls_in"] += len(keys)
+        with srv._lock:
+            owned = srv.ab.owner[keys] >= 0
+            pos = np.nonzero(owned)[0]
+            if len(pos):
+                _fill_flat(out, offs, lens, pos,
+                           srv._read_owned_flat(keys[pos]))
+                owners[pos] = self.pid
+        rem = np.nonzero(~owned)[0]
+        if len(rem):
+            owners[rem] = self._hint_for(keys[rem])
+        return owned.astype(np.uint8), out, owners
+
+    def request_pull(self, keys: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        """Fetch current values of remotely-owned keys (synchronous).
+        Returns (flat values, owners)."""
+        lens = self.server.value_lengths[keys]
+        offs = _offsets(lens)
+        out = np.empty(offs[-1], dtype=np.float32)
+        owners = np.empty(len(keys), dtype=np.int32)
+
+        def merge(reply, pos):
+            served, vals, own = reply[0].astype(bool), reply[1], reply[2]
+            sub_lens = lens[pos]
+            sub_offs = _offsets(sub_lens)
+            spos = np.nonzero(served)[0]
+            _fill_flat(out, offs, lens, pos[spos],
+                       _select_flat(vals, sub_offs, sub_lens, spos))
+            owners[pos[spos]] = own[spos]
+            return own
+
+        self._drive(keys, lambda ks, pos: ("pull", ks),
+                    self._serve_pull, merge, "pull")
+        return out, owners
+
+    def pull_async(self, keys: np.ndarray,
+                   after: Sequence[Future] = ()) -> Future:
+        """Async pull of remote keys; `after` futures (this worker's
+        outstanding remote writes) complete first, preserving
+        read-your-writes across the channel."""
+        after = list(after)
+
+        def task():
+            for f in after:
+                f.result()
+            flat, _ = self.request_pull(keys)
+            return flat
+
+        return self._exec_r.submit(task)
+
+    # -- push / set ---------------------------------------------------------
+
+    def _serve_write(self, msg):
+        """Apply push/set to keys we own; hint the rest. Reply:
+        (served u8[n], owners i32[n])."""
+        op, keys, flat = msg
+        is_set = op == "set"
+        srv = self.server
+        keys = np.asarray(keys, dtype=np.int64)
+        lens = srv.value_lengths[keys]
+        offs = _offsets(lens)
+        owners = np.empty(len(keys), dtype=np.int32)
+        self.stats["pushes_in"] += len(keys)
+        with srv._lock:
+            owned = srv.ab.owner[keys] >= 0
+            pos = np.nonzero(owned)[0]
+            if len(pos):
+                srv._apply_remote_write(
+                    keys[pos], _select_flat(flat, offs, lens, pos), is_set)
+                owners[pos] = self.pid
+        rem = np.nonzero(~owned)[0]
+        if len(rem):
+            owners[rem] = self._hint_for(keys[rem])
+        return owned.astype(np.uint8), owners
+
+    def request_write(self, keys: np.ndarray, flat: np.ndarray,
+                      is_set: bool) -> None:
+        lens = self.server.value_lengths[keys]
+        offs = _offsets(lens)
+        op = "set" if is_set else "push"
+
+        def make(ks, pos):
+            return (op, ks, _select_flat(flat, offs, lens, pos))
+
+        self._drive(keys, make, self._serve_write,
+                    lambda reply, pos: reply[1], op)
+
+    def write_async(self, keys: np.ndarray, flat: np.ndarray,
+                    is_set: bool, after: Sequence[Future] = ()) -> Future:
+        """Async remote write. `after` = the issuing worker's earlier write
+        futures: chaining preserves per-worker write order (push-then-set
+        must land in that order at the owner). Waiting inside the pool is
+        safe: FIFO scheduling means a task only ever waits on
+        earlier-submitted tasks, which are running or done."""
+        keys = keys.copy()
+        flat = np.ascontiguousarray(flat)
+        after = list(after)
+
+        def task():
+            for f in after:
+                f.result()
+            self.request_write(keys, flat, is_set)
+
+        return self._exec_w.submit(task)
+
+    # -- intent: the relocate-vs-replicate decision --------------------------
+
+    def _serve_intent(self, msg):
+        """Owner side (reference ProcessSyncMessage request branch,
+        sync_manager.h:553-739): per key decide relocation vs replication,
+        transfer or register, and return current values. Reply:
+        (served u8, actions u8, vals f32 flat, counters i32, owners i32)."""
+        _, keys, end, req = msg
+        srv = self.server
+        keys = np.asarray(keys, dtype=np.int64)
+        lens = srv.value_lengths[keys]
+        offs = _offsets(lens)
+        n = len(keys)
+        actions = np.zeros(n, dtype=np.uint8)   # 0=replicated, 1=relocated
+        out = np.zeros(offs[-1], dtype=np.float32)
+        counters = np.zeros(n, dtype=np.int32)
+        owners = np.empty(n, dtype=np.int32)
+        self.stats["intents_in"] += n
+        bit = np.uint64(1) << np.uint64(req)
+        rel_keys = np.empty(0, dtype=np.int64)
+        with srv._lock:
+            ab = srv.ab
+            owned = ab.owner[keys] >= 0
+            pos = np.nonzero(owned)[0]
+            if len(pos):
+                ko = keys[pos]
+                tech = srv.opts.techniques
+                if tech == MgmtTechniques.REPLICATION_ONLY:
+                    rel_mask = np.zeros(len(ko), dtype=bool)
+                elif tech == MgmtTechniques.RELOCATION_ONLY:
+                    rel_mask = np.ones(len(ko), dtype=bool)
+                else:
+                    # relocate iff no OTHER process subscribed and no
+                    # owner-local worker interest (active intent or local
+                    # replica) — sync_manager.h:624-644
+                    other = (self.interest[ko] & ~bit) != 0
+                    clocks = srv.shard_min_clocks()
+                    ie = srv.sync.intent_end
+                    local_act = (ie[:, ko] >= clocks[:, None]).any(axis=0)
+                    has_rep = ab.replica_count[ko] > 0
+                    rel_mask = ~other & ~local_act & ~has_rep
+                rel_keys = ko[rel_mask]
+                # forced relocation may move keys that still have local
+                # replicas: flush + drop them first so no delta is lost
+                if len(rel_keys) and (ab.replica_count[rel_keys] > 0).any():
+                    srv._flush_drop_local_replicas(rel_keys)
+                _fill_flat(out, offs, lens, pos, srv._read_owned_flat(ko))
+                ctr = self.reloc[ko].copy()
+                ctr[rel_mask] += 1
+                counters[pos] = ctr
+                actions[pos] = rel_mask.astype(np.uint8)
+                owners[pos] = np.where(rel_mask, req, self.pid)
+                if len(rel_keys):
+                    self.reloc[rel_keys] = ctr[rel_mask]
+                    for cid, cpos in srv._group_by_class(rel_keys):
+                        ab.abandon_batch(rel_keys[cpos])
+                    self.owner_hint[rel_keys] = req
+                    self.interest[rel_keys] = 0
+                    self.stats["relocations_out"] += len(rel_keys)
+                    srv.topology_version += 1
+                rep_keys = ko[~rel_mask]
+                if len(rep_keys):
+                    self.interest[rep_keys] |= bit
+                    self.stats["replicas_granted"] += len(rep_keys)
+        # notify managers of the transfers — from the executor, not this
+        # handler thread (handlers must never block on requests); the
+        # counter check makes late arrival harmless
+        if len(rel_keys):
+            mgr = self.home_proc(rel_keys)
+            ctr_rel = self.reloc[rel_keys]
+            for d in np.unique(mgr):
+                if d in (self.pid, req):
+                    continue  # both already hold the new owner
+                m = mgr == d
+                self._exec_w.submit(self._notify_manager, int(d),
+                                    rel_keys[m], req, ctr_rel[m])
+        rem = np.nonzero(~owned)[0]
+        if len(rem):
+            owners[rem] = self._hint_for(keys[rem])
+        return owned.astype(np.uint8), actions, out, counters, owners
+
+    def _notify_manager(self, dest: int, keys, new_owner, counters):
+        try:
+            self.chan.request(dest, ("owner_update", keys, new_owner,
+                                     counters))
+        except Exception:  # noqa: BLE001 — counters make retries optional
+            from ..utils import alog
+            alog(f"[pm] owner_update to {dest} failed "
+                 f"({len(keys)} keys); manager hint remains stale")
+
+    def intent_remote(self, keys: np.ndarray, shard: int, end: int) -> None:
+        """Requester side: act on an intent for remotely-owned keys — ask
+        each owner to relocate or replicate, then install the outcome
+        locally. Called from the planner (SyncManager._register)."""
+        srv = self.server
+        lens = srv.value_lengths[keys]
+        offs = _offsets(lens)
+        n = len(keys)
+        actions = np.zeros(n, dtype=np.uint8)
+        flat = np.empty(offs[-1], dtype=np.float32)
+        counters = np.zeros(n, dtype=np.int32)
+
+        def merge(reply, pos):
+            served = reply[0].astype(bool)
+            act, vals, ctr, own = reply[1], reply[2], reply[3], reply[4]
+            sub_lens = lens[pos]
+            sub_offs = _offsets(sub_lens)
+            spos = np.nonzero(served)[0]
+            actions[pos[spos]] = act[spos]
+            _fill_flat(flat, offs, lens, pos[spos],
+                       _select_flat(vals, sub_offs, sub_lens, spos))
+            counters[pos[spos]] = ctr[spos]
+            return own
+
+        self._drive(keys, lambda ks, pos: ("intent", ks, end, self.pid),
+                    self._serve_intent, merge, "intent")
+        rel = np.nonzero(actions == 1)[0]
+        rep = np.nonzero(actions == 0)[0]
+        if len(rel):
+            self._adopt(keys[rel], _select_flat(flat, offs, lens, rel),
+                        counters[rel], shard)
+        if len(rep):
+            self._install_replicas(
+                keys[rep], _select_flat(flat, offs, lens, rep), shard)
+
+    def _adopt(self, keys: np.ndarray, flat: np.ndarray,
+               counters: np.ndarray, shard: int) -> None:
+        """Take ownership of relocated keys: merge any pending local replica
+        deltas (replica -> owner upgrade, reference
+        refreshUpgradeReplicaUnsafe handle.h:776-840), then install the rows
+        as main copies on `shard`."""
+        srv = self.server
+        from ..core.store import OOB
+        from ..core.sync import key_channel
+        lens = srv.value_lengths[keys]
+        offs = _offsets(lens)
+        with srv._lock:
+            self.reloc[keys] = counters
+            self.owner_hint[keys] = self.pid
+            ab = srv.ab
+            for cid, pos in srv._group_by_class(keys):
+                ks = keys[pos]
+                L = srv.class_lengths[cid]
+                rows = np.array(
+                    _select_flat(flat, offs, lens, pos).reshape(-1, L))
+                for s in range(srv.num_shards):
+                    cs = ab.cache_slot[s, ks]
+                    has = cs >= 0
+                    if not has.any():
+                        continue
+                    d = srv.stores[cid].read_rows(
+                        "delta", np.full(int(has.sum()), s, np.int32),
+                        cs[has].astype(np.int32))
+                    rows[has] += d
+                    dropped = ks[has]
+                    chans = key_channel(dropped, srv.sync.num_channels)
+                    for k, c in zip(dropped.tolist(), chans.tolist()):
+                        srv.sync.replicas[c].discard((int(k), s))
+                    ab.drop_replicas(dropped, s)
+                slots = ab.adopt_batch(ks, shard)
+                nk = len(ks)
+                srv.stores[cid].set_rows(
+                    np.full(nk, shard, np.int32), slots.astype(np.int32),
+                    rows, np.zeros(nk, np.int32), np.full(nk, OOB, np.int32))
+            srv.topology_version += 1
+            self.stats["relocations_in"] += len(keys)
+            srv.sync.stats.relocations += len(keys)
+
+    def _install_replicas(self, keys: np.ndarray, flat: np.ndarray,
+                          shard: int) -> None:
+        """Install replicas of remote-owned keys on local `shard` with the
+        owner-provided base values."""
+        srv = self.server
+        from ..core.sync import key_channel
+        lens = srv.value_lengths[keys]
+        offs = _offsets(lens)
+        surplus: List[np.ndarray] = []
+        with srv._lock:
+            ab = srv.ab
+            for cid, pos in srv._group_by_class(keys):
+                ks = keys[pos]
+                # an earlier entry in the same drain may have replicated (or
+                # adopted) some of these already
+                fresh = (ab.cache_slot[shard, ks] < 0) & (ab.owner[ks] < 0)
+                ks, pos = ks[fresh], pos[fresh]
+                if len(ks) == 0:
+                    continue
+                L = srv.class_lengths[cid]
+                cs = ab.add_replicas(ks, shard)
+                took = ks[: len(cs)]
+                if len(took):
+                    rows = _select_flat(flat, offs, lens,
+                                        pos[: len(cs)]).reshape(-1, L)
+                    srv.stores[cid].install_replica_rows(
+                        np.full(len(took), shard, np.int32),
+                        cs.astype(np.int32), rows)
+                    chans = key_channel(took, srv.sync.num_channels)
+                    for k, c in zip(took.tolist(), chans.tolist()):
+                        srv.sync.replicas[c].add((int(k), shard))
+                    srv.sync.stats.replicas_created += len(took)
+                if len(cs) < len(ks):  # cache pool full
+                    surplus.append(ks[len(cs):])
+            srv.topology_version += 1
+        if surplus:
+            # the owner registered our interest for keys we could not host:
+            # unsubscribe so they stay relocatable
+            self.unsub(np.concatenate(surplus))
+
+    # -- cross-process sync rounds ------------------------------------------
+
+    def _serve_sync(self, msg):
+        """Owner side of a replica refresh: merge shipped deltas into the
+        main copies, return fresh values (reference owner branch of
+        ProcessSyncMessage, sync_manager.h:553-739). Reply:
+        (served u8, vals f32 flat, owners i32)."""
+        _, keys, flat, req = msg
+        srv = self.server
+        keys = np.asarray(keys, dtype=np.int64)
+        lens = srv.value_lengths[keys]
+        offs = _offsets(lens)
+        out = np.zeros(offs[-1], dtype=np.float32)
+        owners = np.empty(len(keys), dtype=np.int32)
+        self.stats["syncs_in"] += len(keys)
+        bit = np.uint64(1) << np.uint64(req)
+        with srv._lock:
+            owned = srv.ab.owner[keys] >= 0
+            pos = np.nonzero(owned)[0]
+            if len(pos):
+                ko = keys[pos]
+                srv._apply_remote_write(
+                    ko, _select_flat(flat, offs, lens, pos), is_set=False)
+                _fill_flat(out, offs, lens, pos, srv._read_owned_flat(ko))
+                owners[pos] = self.pid
+                self.interest[ko] |= bit  # defensive (e.g. after restore)
+        rem = np.nonzero(~owned)[0]
+        if len(rem):
+            owners[rem] = self._hint_for(keys[rem])
+        return owned.astype(np.uint8), out, owners
+
+    def _request_sync(self, keys: np.ndarray,
+                      flat: np.ndarray) -> np.ndarray:
+        """Ship deltas to owners, return fresh values (synchronous)."""
+        lens = self.server.value_lengths[keys]
+        offs = _offsets(lens)
+        fresh = np.empty(offs[-1], dtype=np.float32)
+
+        def make(ks, pos):
+            return ("sync", ks, _select_flat(flat, offs, lens, pos),
+                    self.pid)
+
+        def merge(reply, pos):
+            served, vals, own = reply[0].astype(bool), reply[1], reply[2]
+            sub_lens = lens[pos]
+            sub_offs = _offsets(sub_lens)
+            spos = np.nonzero(served)[0]
+            _fill_flat(fresh, offs, lens, pos[spos],
+                       _select_flat(vals, sub_offs, sub_lens, spos))
+            return own
+
+        self._drive(keys, make, self._serve_sync, merge, "sync")
+        return fresh
+
+    def sync_replicas(self, items: List[Tuple[int, int]]) -> None:
+        """One cross-process sync round over local replicas of remote keys:
+        extract pending deltas, ship to owners, install fresh bases.
+        Requester side of the reference's startSync/response branch
+        (sync_manager.h:291-382, 740-799)."""
+        srv = self.server
+        karr = np.fromiter((k for k, _ in items), np.int64, len(items))
+        sarr = np.fromiter((s for _, s in items), np.int32, len(items))
+        class_rows: Dict[int, tuple] = {}
+        with srv._lock:
+            # skip replicas dropped/upgraded since the caller's snapshot
+            # (a -1 slot would wrap in the device gather)
+            ok = srv.ab.cache_slot[sarr, karr] >= 0
+            karr, sarr = karr[ok], sarr[ok]
+            if len(karr) == 0:
+                return
+            lens = srv.value_lengths[karr]
+            offs = _offsets(lens)
+            shipped = np.empty(offs[-1], dtype=np.float32)
+            cs_all = srv.ab.cache_slot[sarr, karr].astype(np.int32)
+            for cid, pos in srv._group_by_class(karr):
+                rows = srv.stores[cid].read_rows("delta", sarr[pos],
+                                                 cs_all[pos])
+                class_rows[cid] = (pos, rows)
+                _fill_flat(shipped, offs, lens, pos, rows.ravel())
+        fresh = self._request_sync(karr, shipped)
+        with srv._lock:
+            ab = srv.ab
+            for cid, (pos, rows) in class_rows.items():
+                # replicas may have been dropped/upgraded while the round
+                # was in flight; refresh only still-live ones
+                cs_now = ab.cache_slot[sarr[pos], karr[pos]].astype(np.int32)
+                live = cs_now == cs_all[pos]
+                if not live.any():
+                    continue
+                L = srv.class_lengths[cid]
+                srv.stores[cid].refresh_after_sync(
+                    sarr[pos][live], cs_now[live],
+                    _select_flat(fresh, offs, lens,
+                                 pos[live]).reshape(-1, L),
+                    rows[live])
+        self.stats["keys_synced_out"] += len(items)
+
+    def drop_replicas(self, items: List[Tuple[int, int]]) -> None:
+        """Drop local replicas of remote-owned keys: ship the final delta
+        with the unsubscription, then free the slots. Any pushes that land
+        between extraction and the free are re-shipped as plain remote
+        pushes, so no update is ever lost."""
+        srv = self.server
+        from ..core.sync import key_channel
+        karr = np.fromiter((k for k, _ in items), np.int64, len(items))
+        sarr = np.fromiter((s for _, s in items), np.int32, len(items))
+        class_rows: Dict[int, tuple] = {}
+        with srv._lock:
+            ok = srv.ab.cache_slot[sarr, karr] >= 0
+            karr, sarr = karr[ok], sarr[ok]
+            if len(karr) == 0:
+                return
+            lens = srv.value_lengths[karr]
+            offs = _offsets(lens)
+            shipped = np.empty(offs[-1], dtype=np.float32)
+            cs_all = srv.ab.cache_slot[sarr, karr].astype(np.int32)
+            for cid, pos in srv._group_by_class(karr):
+                rows = srv.stores[cid].read_rows("delta", sarr[pos],
+                                                 cs_all[pos])
+                class_rows[cid] = (pos, rows)
+                _fill_flat(shipped, offs, lens, pos, rows.ravel())
+        self.unsub(karr, shipped)
+        residue_keys: List[np.ndarray] = []
+        residue_flat: List[np.ndarray] = []
+        with srv._lock:
+            ab = srv.ab
+            for cid, (pos, rows) in class_rows.items():
+                # only replicas whose slot is unchanged since extraction:
+                # a concurrent drop/upgrade (e.g. a Set invalidation)
+                # already accounted for its own delta
+                cs_now = ab.cache_slot[sarr[pos], karr[pos]].astype(np.int32)
+                live = cs_now == cs_all[pos]
+                pos, rows = pos[live], rows[live]
+                if len(pos) == 0:
+                    continue
+                now = srv.stores[cid].read_rows("delta", sarr[pos],
+                                                cs_all[pos])
+                rem = now - rows
+                nz = np.abs(rem).max(axis=1) > 0
+                if nz.any():
+                    residue_keys.append(karr[pos][nz])
+                    residue_flat.append(rem[nz].ravel())
+                for s in np.unique(sarr[pos]):
+                    m = sarr[pos] == s
+                    ab.drop_replicas(karr[pos][m], int(s))
+            for k, s in items:
+                c = int(key_channel(np.asarray([k]),
+                                    srv.sync.num_channels)[0])
+                srv.sync.replicas[c].discard((int(k), int(s)))
+            srv.topology_version += 1
+        if residue_keys:
+            self.request_write(np.concatenate(residue_keys),
+                               np.concatenate(residue_flat), is_set=False)
+
+    def unsub(self, keys: np.ndarray,
+              flat: Optional[np.ndarray] = None) -> None:
+        """Tell owners this process no longer holds replicas of `keys`
+        (optionally shipping final deltas)."""
+        lens = self.server.value_lengths[keys]
+        offs = _offsets(lens)
+        if flat is None:
+            flat = np.zeros(offs[-1], dtype=np.float32)
+
+        def make(ks, pos):
+            return ("unsub", ks, _select_flat(flat, offs, lens, pos),
+                    self.pid)
+
+        self._drive(keys, make, self._serve_unsub,
+                    lambda reply, pos: reply[1], "unsub")
+
+    def unsub_async(self, keys: np.ndarray,
+                    after: Sequence[Future] = ()) -> Future:
+        keys = keys.copy()
+        after = list(after)
+
+        def task():
+            for f in after:
+                f.result()
+            self.unsub(keys)
+
+        return self._exec_w.submit(task)
+
+    def _serve_unsub(self, msg):
+        """Reply: (served u8, owners i32)."""
+        _, keys, flat, req = msg
+        srv = self.server
+        keys = np.asarray(keys, dtype=np.int64)
+        lens = srv.value_lengths[keys]
+        offs = _offsets(lens)
+        owners = np.empty(len(keys), dtype=np.int32)
+        bit = np.uint64(1) << np.uint64(req)
+        with srv._lock:
+            owned = srv.ab.owner[keys] >= 0
+            pos = np.nonzero(owned)[0]
+            if len(pos):
+                ko = keys[pos]
+                part = _select_flat(flat, offs, lens, pos)
+                if len(part) and np.abs(part).max() > 0:
+                    srv._apply_remote_write(ko, part, is_set=False)
+                self.interest[ko] &= ~bit
+                owners[pos] = self.pid
+        rem = np.nonzero(~owned)[0]
+        if len(rem):
+            owners[rem] = self._hint_for(keys[rem])
+        return owned.astype(np.uint8), owners
+
+    # -- manager metadata ----------------------------------------------------
+
+    def _serve_owner_update(self, msg):
+        """Manager side: record an ownership transfer, rejecting stale
+        updates by relocation counter (reference addressbook.h:92-102)."""
+        _, keys, new_owner, counters = msg
+        keys = np.asarray(keys, dtype=np.int64)
+        assert (self.home_proc(keys) == self.pid).all(), \
+            "owner_update sent to a non-manager"
+        with self.server._lock:
+            newer = counters > self.reloc[keys]
+            ks = keys[newer]
+            self.owner_hint[ks] = new_owner
+            self.reloc[ks] = counters[newer]
+        return ("ok",)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def report(self) -> str:
+        s = self.stats
+        return (f"pm: pulls_in={s['pulls_in']} pushes_in={s['pushes_in']} "
+                f"redirects={s['redirects']} intents_in={s['intents_in']} "
+                f"reloc_out={s['relocations_out']} "
+                f"reloc_in={s['relocations_in']} "
+                f"rep_granted={s['replicas_granted']} "
+                f"synced_out={s['keys_synced_out']}")
+
+    def shutdown(self) -> None:
+        # peers may still need us to serve; leave together
+        control.barrier("pm-down")
+        self._exec_r.shutdown(wait=True)
+        self._exec_w.shutdown(wait=True)
+        self.chan.shutdown()

@@ -1,0 +1,246 @@
+"""Multi-process test scenarios, run as child processes by
+test_multiprocess.py (one per rank, rendezvoused through the launcher env
+contract). Each scenario is the multi-process twin of the reference's
+self-checking test binaries (tests/test_many_key_operations.cc,
+tests/test_locality_api.cc) launched by tracker/dmlc_local.py.
+
+Usage: ADAPM_* env set by the launcher; argv[1] = scenario name.
+"""
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["ADAPM_PLATFORM"] = "cpu"
+os.environ.setdefault(
+    "XLA_FLAGS", "--xla_force_host_platform_device_count=2")
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.1")
+os.environ.pop("PYTHONPATH", None)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import adapm_tpu  # noqa: E402
+from adapm_tpu.base import CLOCK_MAX, LOCAL, NO_SLOT, NOT_CACHED, REMOTE  # noqa: E402
+from adapm_tpu.config import SystemOptions  # noqa: E402
+from adapm_tpu.parallel import control  # noqa: E402
+
+
+def owned_by_proc(srv, proc, n=None):
+    """Keys whose INITIAL home process is `proc` (key % (S*P) // S)."""
+    keys = np.arange(srv.num_keys, dtype=np.int64)
+    mine = keys[srv.glob.home_proc(keys) == proc]
+    return mine if n is None else mine[:n]
+
+
+def scenario_pullpush():
+    """Cross-process Pull/Push/Set with exact values (the reference's
+    test_many_key_operations value checks, phases 1-2)."""
+    srv = adapm_tpu.setup(64, 4, opts=SystemOptions(sync_max_per_sec=0))
+    rank = control.process_id()
+    P = control.num_processes()
+    w = srv.make_worker(0)
+    keys = np.arange(64, dtype=np.int64)
+    base = np.arange(64, dtype=np.float32)[:, None] * np.ones(4, np.float32)
+    if rank == 0:
+        ts = w.set(keys, base)
+        w.wait(ts)
+    srv.barrier()
+    vals = w.pull_sync(keys)
+    assert np.allclose(vals, base), f"pull after set mismatch\n{vals[:4]}"
+    # every rank pushes +1 to every key -> each key gains +P exactly
+    ts = w.push(keys, np.ones((64, 4), np.float32))
+    w.wait(ts)
+    srv.barrier()
+    vals = w.pull_sync(keys)
+    assert np.allclose(vals, base + P), f"pull after pushes\n{vals[:4]}"
+    rm = srv.read_main(keys).reshape(64, 4)
+    assert np.allclose(rm, base + P), "read_main disagrees"
+    # locality: this worker's own keys answered locally
+    mine = owned_by_proc(srv, rank)
+    mine = mine[srv.ab.owner[mine] == w.shard]
+    assert w.pull(mine) == LOCAL, "own-shard keys should be LOCAL"
+    srv.barrier()
+    srv.shutdown()
+    print(f"MP-OK pullpush rank={rank}")
+
+
+def scenario_intent_locality():
+    """Rank 1's intent MOVES rank-0-owned keys (exclusive -> relocation);
+    rank 0's competing intent then REPLICATES them back (reference
+    test_locality_api semantics, cross-process)."""
+    srv = adapm_tpu.setup(64, 4, opts=SystemOptions(sync_max_per_sec=0))
+    rank = control.process_id()
+    w = srv.make_worker(0)
+    keys = owned_by_proc(srv, 0, 8)
+    if rank == 0:
+        ts = w.set(keys, np.full((8, 4), 7.0, np.float32))
+        w.wait(ts)
+    srv.barrier()
+    if rank == 1:
+        w.intent(keys, 0, CLOCK_MAX)
+        srv.wait_sync()
+        assert (srv.ab.owner[keys] >= 0).all(), \
+            "exclusive intent should relocate cross-process"
+        assert srv.glob.stats["relocations_in"] >= 8
+        v = w.pull_sync(keys)
+        assert np.allclose(v, 7.0), f"value lost in relocation: {v}"
+    srv.barrier()
+    if rank == 0:
+        assert (srv.ab.owner[keys] == REMOTE).all(), \
+            "rank 0 should have released ownership"
+        assert (srv.glob.owner_hint[keys] == 1).all(), \
+            "manager/owner hint should track the transfer"
+        # competing intent: rank 1 still holds intent -> replicate here
+        w.intent(keys, 0, CLOCK_MAX)
+        srv.wait_sync()
+        assert (srv.ab.cache_slot[w.shard, keys] != NO_SLOT).all(), \
+            "competing intent should replicate"
+        assert w.pull(keys) == LOCAL, "replicated keys should be LOCAL"
+    srv.barrier()
+    # rank 1 pushes on its (now owned) keys; rank 0's replicas converge
+    # after the quiesce protocol (WaitSync -> Barrier -> WaitSync)
+    if rank == 1:
+        ts = w.push(keys, np.ones((8, 4), np.float32))
+        w.wait(ts)
+    w.wait_all()
+    srv.wait_sync()
+    srv.barrier()
+    srv.wait_sync()
+    srv.barrier()
+    v = w.pull_sync(keys)
+    assert np.allclose(v, 8.0), f"rank {rank} sees {v[:2]} after quiesce"
+    srv.shutdown()
+    print(f"MP-OK intent_locality rank={rank}")
+
+
+def scenario_monotonic():
+    """Concurrent contended pushes under intent churn with the background
+    sync thread running: a worker's own applied pushes are never lost
+    (monotonicity), and after quiesce the value is exactly P * R
+    (reference test_many_key_operations phases 2-3 +
+    test_dynamic_allocation)."""
+    srv = adapm_tpu.setup(32, 2, opts=SystemOptions(sync_max_per_sec=500))
+    rank = control.process_id()
+    P = control.num_processes()
+    srv.start_sync_thread()
+    w = srv.make_worker(0)
+    contended = int(owned_by_proc(srv, 0, 1)[0])
+    rng = np.random.default_rng(rank)
+    R = 30
+    applied = 0
+    kk = np.array([contended], dtype=np.int64)
+    for i in range(R):
+        if rng.random() < 0.4:
+            w.intent(kk, w.current_clock, w.current_clock + 3)
+        ts = w.push(kk, np.ones((1, 2), np.float32))
+        w.wait(ts)
+        applied += 1
+        v = float(w.pull_sync(kk)[0, 0])
+        assert v + 1e-3 >= applied, \
+            f"rank {rank}: pulled {v} < own applied {applied}"
+        w.advance_clock()
+    w.wait_all()
+    srv.wait_sync()
+    srv.barrier()
+    srv.wait_sync()
+    srv.barrier()
+    final = float(srv.read_main(kk)[0])
+    assert abs(final - P * R) < 1e-3, \
+        f"rank {rank}: final {final} != {P * R} (lost/duplicated updates)"
+    v = float(w.pull_sync(kk)[0, 0])
+    assert abs(v - P * R) < 1e-3, f"rank {rank}: pull {v} != {P * R}"
+    srv.barrier()
+    srv.shutdown()
+    print(f"MP-OK monotonic rank={rank}")
+
+
+def scenario_eventual():
+    """Eventual consistency: every rank pushes then reverts on a shared key
+    set under replication; after the quiesce protocol all ranks read the
+    exact base everywhere (reference test_many_key_operations phase 3)."""
+    srv = adapm_tpu.setup(48, 4, opts=SystemOptions(sync_max_per_sec=0))
+    rank = control.process_id()
+    w = srv.make_worker(0)
+    keys = np.arange(48, dtype=np.int64)
+    base = np.arange(48, dtype=np.float32)[:, None] * np.ones(4, np.float32)
+    if rank == 0:
+        w.wait(w.set(keys, base))
+    srv.barrier()
+    # everyone subscribes everywhere -> full replication pressure
+    w.intent(keys, 0, CLOCK_MAX)
+    srv.wait_sync()
+    srv.barrier()
+    x = np.full((48, 4), 2.5 + rank, np.float32)
+    w.wait(w.push(keys, x))
+    w.wait(w.push(keys, -x))
+    w.wait_all()
+    srv.wait_sync()
+    srv.barrier()
+    srv.wait_sync()
+    srv.barrier()
+    v = w.pull_sync(keys)
+    assert np.allclose(v, base, atol=1e-4), \
+        f"rank {rank}: not restored\n{(v - base)[:4]}"
+    rm = srv.read_main(keys).reshape(48, 4)
+    assert np.allclose(rm, base, atol=1e-4), f"rank {rank}: main differs"
+    srv.barrier()
+    srv.shutdown()
+    print(f"MP-OK eventual rank={rank}")
+
+
+def scenario_location_caches():
+    """3 processes: after a relocation 0 -> 1, rank 2's first pull routes
+    via the manager (redirect) and LEARNS the owner; the second goes one
+    hop. With --sys.location_caches 0 the hint table stays cold and every
+    access re-routes via the manager (reference addressbook.h:114-133)."""
+    caches = bool(int(sys.argv[2])) if len(sys.argv) > 2 else True
+    srv = adapm_tpu.setup(12, 4, opts=SystemOptions(
+        sync_max_per_sec=0, location_caches=caches))
+    rank = control.process_id()
+    w = srv.make_worker(0)
+    k = owned_by_proc(srv, 0, 1)  # managed (and initially owned) by rank 0
+    if rank == 0:
+        w.wait(w.set(k, np.full((1, 4), 5.0, np.float32)))
+    srv.barrier()
+    if rank == 1:
+        w.intent(k, 0, CLOCK_MAX)
+        srv.wait_sync()
+        assert (srv.ab.owner[k] >= 0).all()
+    srv.barrier()
+    if rank == 2:
+        assert float(w.pull_sync(k)[0, 0]) == 5.0
+        if caches:
+            assert srv.glob.owner_hint[k[0]] == 1, \
+                "location cache should have learned the relocated owner"
+        else:
+            assert srv.glob.owner_hint[k[0]] == NOT_CACHED, \
+                "caches off: hint table must stay cold"
+        # second pull: with caches, one hop straight to the owner
+        before = srv.glob.stats["redirects"]
+        assert float(w.pull_sync(k)[0, 0]) == 5.0
+        if caches:
+            assert srv.glob.stats["redirects"] == before, \
+                "cached owner should not redirect"
+    srv.barrier()
+    if rank == 0 and caches:
+        # the manager redirected rank 2's first pull instead of serving it
+        assert srv.glob.stats["pulls_in"] >= 1
+    srv.barrier()
+    srv.shutdown()
+    print(f"MP-OK location_caches rank={rank}")
+
+
+SCENARIOS = {
+    "pullpush": scenario_pullpush,
+    "intent_locality": scenario_intent_locality,
+    "monotonic": scenario_monotonic,
+    "eventual": scenario_eventual,
+    "location_caches": scenario_location_caches,
+}
+
+if __name__ == "__main__":
+    SCENARIOS[sys.argv[1]]()

@@ -1,0 +1,86 @@
+"""Multi-process parameter-manager integration tests.
+
+The reference's core test strategy is N real server processes + a scheduler
+on localhost (tracker/dmlc_local.py, SURVEY.md §4); here N real Python
+processes rendezvous through the jax.distributed coordinator and exchange
+parameter traffic over the DCN channel (parallel/pm.py). Scenarios live in
+tests/mp_scenarios.py — the multi-process twins of
+test_many_key_operations.cc / test_locality_api.cc phases.
+"""
+import os
+import subprocess
+import sys
+
+import pytest
+
+from adapm_tpu import launcher
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+SCENARIOS = os.path.join(HERE, "mp_scenarios.py")
+REPO = os.path.dirname(HERE)
+
+
+def run_mp(n, scenario, devices=2, args=(), timeout=300):
+    """Launch `n` ranks of a scenario; assert all exit 0."""
+    env = dict(os.environ)
+    # children need the repo importable but NOT the TPU-tunnel site
+    env["PYTHONPATH"] = REPO
+    env["JAX_PLATFORMS"] = "cpu"
+    env["ADAPM_PLATFORM"] = "cpu"
+    env["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={devices}"
+    coordinator = f"localhost:{launcher.free_port()}"
+    procs = [subprocess.Popen(
+        [sys.executable, SCENARIOS, scenario, *map(str, args)],
+        env=launcher.make_env(r, n, coordinator, env),
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        for r in range(n)]
+    outs = []
+    try:
+        outs = [p.communicate(timeout=timeout)[0].decode() for p in procs]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for r, (p, o) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {r} failed:\n{o[-4000:]}"
+        assert f"MP-OK {scenario} rank={r}" in o
+    return outs
+
+
+@pytest.mark.slow
+def test_mp_pull_push_set():
+    """Cross-process Pull/Push/Set land exactly (2 procs x 2 devices)."""
+    run_mp(2, "pullpush")
+
+
+@pytest.mark.slow
+def test_mp_intent_relocation_replication():
+    """Rank 1's intent moves rank-0-owned keys; a competing intent
+    replicates them back; pushes converge after quiesce."""
+    run_mp(2, "intent_locality")
+
+
+@pytest.mark.slow
+def test_mp_monotonic_contended_pushes():
+    """Own pushes never lost under churn; final value exact (3 procs)."""
+    run_mp(3, "monotonic")
+
+
+@pytest.mark.slow
+def test_mp_eventual_consistency():
+    """Push+revert restores the exact base on every rank after
+    WaitSync -> Barrier -> WaitSync (2 procs)."""
+    run_mp(2, "eventual")
+
+
+@pytest.mark.slow
+def test_mp_location_caches_on():
+    """Second pull of a relocated key takes one hop (3 procs x 1 device)."""
+    run_mp(3, "location_caches", devices=1, args=(1,))
+
+
+@pytest.mark.slow
+def test_mp_location_caches_off():
+    """--sys.location_caches 0: hint table stays cold, routing still
+    converges via the manager."""
+    run_mp(3, "location_caches", devices=1, args=(0,))

@@ -47,9 +47,3 @@ class MgmtTechniques(enum.Enum):
     ALL = "all"
     REPLICATION_ONLY = "replication_only"
     RELOCATION_ONLY = "relocation_only"
-
-
-class OpType(enum.Enum):
-    PULL = "pull"
-    PUSH = "push"
-    SET = "set"

@@ -124,6 +124,11 @@ class Server:
         # cross-process layer: N launched processes form one PM
         # (parallel/pm.py; reference van/postoffice data plane)
         self.glob = None
+        # outstanding remote writes (future, keys): replication of a key
+        # with an in-flight remote write is deferred — the owner's base
+        # snapshot might miss the write, breaking read-your-own-pushes
+        # (pm.py _install_replicas)
+        self._rw_pending: List = []
         if self.num_procs > 1:
             from ..parallel.pm import GlobalPM
             self.glob = GlobalPM(self)
@@ -247,15 +252,14 @@ class Server:
     def _flat_parts(self, keys: np.ndarray, flat: np.ndarray, positions,
                     length: int) -> np.ndarray:
         """Extract [n, L] rows for `positions` of `keys` out of a flat
-        concatenated value buffer (offsets are relative to this batch)."""
+        concatenated value buffer (offsets are relative to this batch).
+        Vectorized via the shared ragged-buffer helpers (parallel/pm.py) —
+        never a per-key loop (a full-model push at Wikidata5M scale passes
+        through here)."""
+        from ..parallel.pm import _offsets, _select_flat
         lens = self.value_lengths[keys]
-        offs = np.zeros(len(keys) + 1, dtype=np.int64)
-        np.cumsum(lens, out=offs[1:])
-        rows = np.empty((len(positions), length), dtype=flat.dtype)
-        for i, p in enumerate(positions):
-            o = offs[p]
-            rows[i] = flat[o:o + length]
-        return rows
+        return _select_flat(flat, _offsets(lens), lens,
+                            np.asarray(positions)).reshape(-1, length)
 
     # -- core ops (called by Worker; all under the server lock) --------------
 
@@ -349,6 +353,9 @@ class Server:
                     # until we unsubscribe; do it once the set has landed
                     fut = self.glob.unsub_async(hk, after=[fut])
                 futures.append(fut)
+                if len(self._rw_pending) > 64:
+                    self._prune_rw_pending()
+                self._rw_pending.append((fut, rem_keys))
                 n_remote += len(rem_pos)
                 loc_pos = np.nonzero(~proc_rem)[0]
                 if flat:
@@ -378,10 +385,31 @@ class Server:
 
     # -- cross-process service endpoints (called by GlobalPM under _lock) ----
 
+    # full-model reads switch to one whole-pool device->host copy per class
+    # instead of a padded device gather: at 5M keys the gather program (and
+    # its compile) costs minutes, the pool copy seconds
+    _BULK_READ_MIN = 65536
+
     def _read_owned_flat(self, keys: np.ndarray) -> np.ndarray:
         """Current main-copy values of locally-owned keys (flat concat)."""
+        if len(keys) >= self._BULK_READ_MIN:
+            return self._read_owned_bulk(keys)
         groups, _ = self._pull_main_only(keys)
         return self._assemble_flat(keys, groups)
+
+    def _read_owned_bulk(self, keys: np.ndarray) -> np.ndarray:
+        """Checkpoint/eval/export-scale read: copy each class pool to host
+        once, then reorder rows with a vectorized fancy index."""
+        from ..parallel.pm import _fill_flat, _offsets
+        lens = self.value_lengths[keys]
+        offs = _offsets(lens)
+        out = np.empty(offs[-1], dtype=np.float32)
+        for cid, pos in self._group_by_class(keys):
+            ks = keys[pos]
+            host = np.asarray(self.stores[cid].main)   # [S, slots, L]
+            rows = host[self.ab.owner[ks], self.ab.slot[ks]]
+            _fill_flat(out, offs, lens, pos, rows.ravel())
+        return out
 
     def _apply_remote_write(self, keys: np.ndarray, flat: np.ndarray,
                             is_set: bool) -> None:
@@ -400,6 +428,20 @@ class Server:
                 self.stores[cid].set_rows(o_sh, o_sl, rows, zeros, oob)
             else:
                 self.stores[cid].scatter_add(o_sh, o_sl, zeros, oob, rows)
+
+    def _prune_rw_pending(self) -> None:
+        """Drop completed remote-write records (caller holds the lock). A
+        completed future means the write is applied at its owner, so any
+        owner-side read AFTER the prune observes it."""
+        self._rw_pending = [(f, k) for f, k in self._rw_pending
+                            if not f.done()]
+
+    def _rw_blocked_keys(self):
+        """Keys with remote writes recorded since the last prune (caller
+        holds the lock); replication installs must skip them."""
+        if not self._rw_pending:
+            return None
+        return np.unique(np.concatenate([k for _, k in self._rw_pending]))
 
     def _drop_cross_replicas(self, keys: np.ndarray, shard: int) -> None:
         """Drop this shard's replicas of remotely-owned `keys` (metadata +
@@ -727,6 +769,8 @@ class Server:
         keys = np.asarray(keys, dtype=np.int64)
         if self.glob is None:
             with self._lock:
+                if len(keys) >= self._BULK_READ_MIN:
+                    return self._read_owned_bulk(keys)
                 groups, _ = self._pull_main_only(keys)
             return self._assemble_flat(keys, groups)
         from ..parallel.pm import _fill_flat, _offsets
@@ -761,25 +805,15 @@ class Server:
 
     def _assemble_flat(self, keys: np.ndarray, groups,
                        remote=None) -> np.ndarray:
-        total = int(self.val_offsets[keys + 1].sum()
-                    - self.val_offsets[keys].sum())
-        out = np.empty(total, dtype=np.float32)
-        # per-key offset within the output buffer
+        from ..parallel.pm import _fill_flat, _offsets
         lens = self.value_lengths[keys]
-        offs = np.zeros(len(keys) + 1, dtype=np.int64)
-        np.cumsum(lens, out=offs[1:])
-        uniform = len(self.class_lengths) == 1
+        offs = _offsets(lens)
+        out = np.empty(offs[-1], dtype=np.float32)
         for cid, pos, klens, vals, n in groups:
-            host = np.asarray(vals)[:n]
-            L = self.class_lengths[cid]
-            if uniform:
-                # single length class: one strided write, not a per-key loop
-                out.reshape(-1, L)[pos] = host
-                continue
-            for i, p in enumerate(pos):
-                out[offs[p]:offs[p] + L] = host[i]
+            # one strided/fancy-indexed write per class, never per key
+            _fill_flat(out, offs, lens, np.asarray(pos),
+                       np.asarray(vals)[:n].ravel())
         if remote is not None:
-            from ..parallel.pm import _fill_flat
             rem_pos, fut = remote
             _fill_flat(out, offs, lens, rem_pos, fut.result())
         return out

@@ -238,6 +238,12 @@ class SyncManager:
     def run_round(self, force_intents: bool = False,
                   all_channels: bool = False) -> None:
         self._throttle()
+        if self.server._in_setup and not force_intents:
+            # BeginSetup/EndSetup bracket (reference coloc_kv_worker.h):
+            # management is paused so bulk Set/Push of initial values runs
+            # at full speed; EndSetup's barrier resumes it. An explicit
+            # WaitSync (force) still acts.
+            return
         self.drain_intents(force=force_intents)
         if all_channels:
             for c in range(self.num_channels):

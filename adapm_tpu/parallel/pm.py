@@ -69,6 +69,12 @@ def _uniform(lens: np.ndarray) -> Optional[int]:
     return int(lens[0]) if len(lens) and (lens == lens[0]).all() else None
 
 
+def _ragged_arange(lens: np.ndarray) -> np.ndarray:
+    """concat([arange(l) for l in lens]) without the Python loop."""
+    offs = _offsets(lens)
+    return np.arange(offs[-1]) - np.repeat(offs[:-1], lens)
+
+
 def _select_flat(flat: np.ndarray, offs: np.ndarray, lens: np.ndarray,
                  pos: np.ndarray) -> np.ndarray:
     """Extract the value segments of key positions `pos` from a flat concat
@@ -78,7 +84,10 @@ def _select_flat(flat: np.ndarray, offs: np.ndarray, lens: np.ndarray,
     u = _uniform(lens)
     if u is not None:
         return np.ascontiguousarray(flat.reshape(-1, u)[pos]).ravel()
-    return np.concatenate([flat[offs[p]:offs[p + 1]] for p in pos])
+    # mixed lengths: one repeat-based index build, no per-key loop
+    sub = lens[pos]
+    idx = np.repeat(offs[pos], sub) + _ragged_arange(sub)
+    return flat[idx]
 
 
 def _fill_flat(out: np.ndarray, offs: np.ndarray, lens: np.ndarray,
@@ -91,9 +100,9 @@ def _fill_flat(out: np.ndarray, offs: np.ndarray, lens: np.ndarray,
     if u is not None:
         out.reshape(-1, u)[pos] = part.reshape(len(pos), u)
         return
-    poffs = _offsets(lens[pos])
-    for i, p in enumerate(pos):
-        out[offs[p]:offs[p] + lens[p]] = part[poffs[i]:poffs[i + 1]]
+    sub = lens[pos]
+    idx = np.repeat(offs[pos], sub) + _ragged_arange(sub)
+    out[idx] = part
 
 
 class GlobalPM:
@@ -449,6 +458,12 @@ class GlobalPM:
         each owner to relocate or replicate, then install the outcome
         locally. Called from the planner (SyncManager._register)."""
         srv = self.server
+        # writes completed before this point are applied at their owners,
+        # so the owner's base snapshot during this RPC will include them;
+        # anything still pending (or submitted during the RPC) stays in
+        # _rw_pending and blocks installation of that key's replica below
+        with srv._lock:
+            srv._prune_rw_pending()
         lens = srv.value_lengths[keys]
         offs = _offsets(lens)
         n = len(keys)
@@ -533,11 +548,21 @@ class GlobalPM:
         surplus: List[np.ndarray] = []
         with srv._lock:
             ab = srv.ab
+            # keys with an in-flight remote write: the owner's base
+            # snapshot may predate the write landing, so installing it
+            # would let a local read miss the worker's own push. Defer —
+            # the key stays remote and a later intent drain retries.
+            blocked = srv._rw_blocked_keys()
             for cid, pos in srv._group_by_class(keys):
                 ks = keys[pos]
                 # an earlier entry in the same drain may have replicated (or
                 # adopted) some of these already
                 fresh = (ab.cache_slot[shard, ks] < 0) & (ab.owner[ks] < 0)
+                if blocked is not None:
+                    fresh &= ~np.isin(ks, blocked)
+                    skipped = ks[np.isin(ks, blocked)]
+                    if len(skipped):
+                        surplus.append(skipped)
                 ks, pos = ks[fresh], pos[fresh]
                 if len(ks) == 0:
                     continue
@@ -796,8 +821,9 @@ class GlobalPM:
                 f"synced_out={s['keys_synced_out']}")
 
     def shutdown(self) -> None:
-        # peers may still need us to serve; leave together
-        control.barrier("pm-down")
+        # drain our outbound traffic FIRST, then leave together: a peer
+        # must not close its channel while our last writes are in flight
         self._exec_r.shutdown(wait=True)
         self._exec_w.shutdown(wait=True)
+        control.barrier("pm-down")
         self.chan.shutdown()

@@ -36,5 +36,14 @@ for _ in range(50):
 srv.block()
 t1 = time.perf_counter()
 print(f"push(4096 keys) steady state: {(t1-t0)/50*1e3:.2f} ms/op")
+
+# full-model read (checkpoint/eval/export path): must be slice copies per
+# class, never a per-key Python loop (VERDICT r2 weak #3)
+t0 = time.perf_counter()
+full = srv.read_main(np.arange(5_000_000))
+t1 = time.perf_counter()
+print(f"read_main(5M keys): {t1-t0:.2f}s ({full.nbytes/2**20:.0f} MiB)")
+assert t1 - t0 < 60.0, "full-model read too slow (per-key loop?)"
+
 srv.shutdown()
 print("SCALE OK")

@@ -24,6 +24,27 @@ def test_staggered_push():
     srv.shutdown()
 
 
+def test_begin_setup_pauses_management():
+    """BeginSetup/EndSetup bracket (reference coloc_kv_worker.h): sync
+    rounds are no-ops while in setup, so bulk init runs management-free;
+    EndSetup resumes (and barriers)."""
+    from adapm_tpu.base import CLOCK_MAX
+    srv = adapm_tpu.setup(32, 4, opts=SystemOptions(sync_max_per_sec=0))
+    w = srv.make_worker(0)
+    w.begin_setup()
+    remote = np.array([k for k in range(32) if srv.ab.owner[k] != w.shard])
+    w.intent(remote[:4], 0, CLOCK_MAX)
+    srv.sync.run_round(all_channels=True)
+    assert srv.sync.stats.intents_processed == 0, \
+        "management must pause during setup"
+    w.end_setup()
+    srv.wait_sync()
+    assert srv.sync.stats.intents_processed > 0, \
+        "management must resume after setup"
+    assert srv.ab.is_local(remote[:4], w.shard).all()
+    srv.shutdown()
+
+
 def test_pull_if_local():
     srv = adapm_tpu.setup(16, 2, opts=SystemOptions(sync_max_per_sec=0))
     w = srv.make_worker(0)

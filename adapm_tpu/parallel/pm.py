@@ -559,10 +559,14 @@ class GlobalPM:
                 # adopted) some of these already
                 fresh = (ab.cache_slot[shard, ks] < 0) & (ab.owner[ks] < 0)
                 if blocked is not None:
-                    fresh &= ~np.isin(ks, blocked)
-                    skipped = ks[np.isin(ks, blocked)]
+                    bl = np.isin(ks, blocked)
+                    # only keys that WOULD have been installed are deferred
+                    # + unsubscribed; keys already replicated/adopted keep
+                    # their registration (unsub would orphan them)
+                    skipped = ks[fresh & bl]
                     if len(skipped):
                         surplus.append(skipped)
+                    fresh &= ~bl
                 ks, pos = ks[fresh], pos[fresh]
                 if len(ks) == 0:
                     continue
@@ -821,8 +825,16 @@ class GlobalPM:
                 f"synced_out={s['keys_synced_out']}")
 
     def shutdown(self) -> None:
-        # drain our outbound traffic FIRST, then leave together: a peer
-        # must not close its channel while our last writes are in flight
+        # Three-step leave-together protocol:
+        # 1. pre-down barrier: every rank's planner (sync thread) is
+        #    stopped before Server.shutdown reaches here, and a peer's
+        #    in-flight request completes before that peer can enter the
+        #    barrier — so afterwards no NEW inbound work (and no handler
+        #    submits to our executors) can appear.
+        # 2. drain our own outbound executors: peers still serve, their
+        #    channels stay open until step 3.
+        # 3. down barrier, then close the channel.
+        control.barrier("pm-pre-down")
         self._exec_r.shutdown(wait=True)
         self._exec_w.shutdown(wait=True)
         control.barrier("pm-down")

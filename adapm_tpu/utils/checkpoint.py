@@ -8,25 +8,51 @@ checkpoint captures it exactly: pools (main/cache/delta per length class),
 addressbook tables, registered intent horizons, and worker clocks. Restore
 rebuilds the free-list allocators and the sync manager's replica registry
 from the tables, so an adapted placement survives a restart.
+
+Multi-process: each rank writes `<path>.rank<r>.npz` with its local pools,
+tables, and cross-process metadata (owner hints, relocation counters,
+interest bitmasks), bracketed by the quiesce protocol (WaitSync -> Barrier
+-> WaitSync) so the shards are mutually consistent; rank 0 also writes a
+`<path>.manifest.npz` pinning the topology. Restore loads each rank's shard
+into a freshly-launched job of the same shape — the adapted placement
+(including cross-process relocations and replicas) survives the restart.
 """
 from __future__ import annotations
 
+import os
 from typing import Dict
 
 import numpy as np
 
 
-FORMAT_VERSION = 1
+FORMAT_VERSION = 2
+
+
+def rank_path(path: str, rank: int) -> str:
+    return f"{path}.rank{rank}.npz"
+
+
+def manifest_path(path: str) -> str:
+    return f"{path}.manifest.npz"
 
 
 def save_server(server, path: str) -> None:
-    """Write the full manager state to an .npz (single-controller view)."""
+    """Write the full manager state (single-controller: one .npz;
+    multi-process: per-rank shards + manifest, globally quiesced)."""
+    if server.glob is not None:
+        # quiesce so every delta is merged and every base is fresh
+        server.wait_sync()
+        server.barrier()
+        server.wait_sync()
+        server.barrier()
     server.block()
     with server._lock:
         arrs: Dict[str, np.ndarray] = {
             "format_version": np.int64(FORMAT_VERSION),
             "num_keys": np.int64(server.num_keys),
             "num_shards": np.int64(server.num_shards),
+            "num_procs": np.int64(server.num_procs),
+            "pid": np.int64(server.pid),
             "value_lengths": server.value_lengths,
             "owner": server.ab.owner,
             "slot": server.ab.slot,
@@ -35,18 +61,43 @@ def save_server(server, path: str) -> None:
             "intent_end": server.sync.intent_end,
             "clocks": server._clocks,
         }
+        if server.glob is not None:
+            arrs["owner_hint"] = server.glob.owner_hint
+            arrs["reloc"] = server.glob.reloc
+            arrs["interest"] = server.glob.interest
         for cid, st in enumerate(server.stores):
             arrs[f"main_{cid}"] = np.asarray(st.main)
             arrs[f"cache_{cid}"] = np.asarray(st.cache)
             arrs[f"delta_{cid}"] = np.asarray(st.delta)
-    np.savez_compressed(path, **arrs)
+    if server.glob is None:
+        np.savez_compressed(path, **arrs)
+        return
+    np.savez_compressed(rank_path(path, server.pid), **arrs)
+    if server.pid == 0:
+        np.savez_compressed(manifest_path(path),
+                            format_version=np.int64(FORMAT_VERSION),
+                            num_procs=np.int64(server.num_procs),
+                            num_shards=np.int64(server.num_shards),
+                            num_keys=np.int64(server.num_keys))
+    server.barrier()  # checkpoint complete on every rank
 
 
 def restore_server(server, path: str) -> None:
     """Restore state saved by save_server into a compatibly-constructed
-    Server (same num_keys, value_lengths, shard count, pool geometry)."""
+    Server (same num_keys, value_lengths, shard count, pool geometry;
+    multi-process: same process count — each rank reads its own shard)."""
     import jax
-    ck = np.load(path)
+    if server.glob is not None:
+        mf = np.load(manifest_path(path))
+        assert int(mf["num_procs"]) == server.num_procs, \
+            "process count mismatch (elastic restore is not supported)"
+        ck = np.load(rank_path(path, server.pid))
+        assert int(ck["pid"]) == server.pid
+    else:
+        ck = np.load(path if os.path.exists(path) else rank_path(path, 0))
+        assert int(ck["num_procs"]) == 1, (
+            "this is one rank shard of a multi-process checkpoint; restore "
+            "it under a launcher with the same process count")
     assert int(ck["format_version"]) == FORMAT_VERSION
     assert int(ck["num_keys"]) == server.num_keys, "key count mismatch"
     assert int(ck["num_shards"]) == server.num_shards, "shard mismatch"
@@ -97,8 +148,14 @@ def restore_server(server, path: str) -> None:
                             server.sync.num_channels)
         for k, s, c in zip(keys, shards, chans):
             server.sync.replicas[int(c)].add((int(k), int(s)))
+        if server.glob is not None:
+            server.glob.owner_hint[:] = ck["owner_hint"]
+            server.glob.reloc[:] = ck["reloc"]
+            server.glob.interest[:] = ck["interest"]
         server.topology_version += 1
     server.block()
+    if server.glob is not None:
+        server.barrier()  # all ranks restored before traffic resumes
 
 
 def _rebuild_alloc(alloc, owners: np.ndarray, slots: np.ndarray) -> None:

@@ -234,12 +234,87 @@ def scenario_location_caches():
     print(f"MP-OK location_caches rank={rank}")
 
 
+def scenario_ckpt_save():
+    """Phase 1 of the crash-recovery test: adapt placement (cross-process
+    relocation + replication), push values, checkpoint, then 'crash'
+    (exit). Phase 2 (ckpt_restore) runs as a fresh launch."""
+    from adapm_tpu.utils.checkpoint import save_server
+    path = sys.argv[2]
+    srv = adapm_tpu.setup(48, 4, opts=SystemOptions(sync_max_per_sec=0))
+    rank = control.process_id()
+    w = srv.make_worker(0)
+    keys = np.arange(48, dtype=np.int64)
+    if rank == 0:
+        w.wait(w.set(keys, np.arange(48, dtype=np.float32)[:, None]
+                     * np.ones(4, np.float32)))
+    srv.barrier()
+    # rank 1 takes exclusive ownership of some rank-0 keys; rank 0 then
+    # subscribes to two of them -> cross-process replicas exist at save
+    moved = owned_by_proc(srv, 0, 6)
+    if rank == 1:
+        w.intent(moved, 0, CLOCK_MAX)
+        srv.wait_sync()
+        assert (srv.ab.owner[moved] >= 0).all()
+    srv.barrier()
+    if rank == 0:
+        w.intent(moved[:2], 0, CLOCK_MAX)
+        srv.wait_sync()
+    srv.barrier()
+    w.wait(w.push(keys, np.ones((48, 4), np.float32)))
+    w.wait_all()
+    save_server(srv, path)  # runs the distributed quiesce internally
+    srv.shutdown()
+    print(f"MP-OK ckpt_save rank={rank}")
+
+
+def scenario_ckpt_restore():
+    """Phase 2: fresh launch restores the rank shards; values, adapted
+    placement, and the consistency invariant must survive."""
+    from adapm_tpu.utils.checkpoint import restore_server
+    path = sys.argv[2]
+    srv = adapm_tpu.setup(48, 4, opts=SystemOptions(sync_max_per_sec=0))
+    rank = control.process_id()
+    w = srv.make_worker(0)
+    restore_server(srv, path)
+    keys = np.arange(48, dtype=np.int64)
+    # set(k) + one push(+1) from each of the two ranks before the save
+    base = (np.arange(48, dtype=np.float32)[:, None]
+            * np.ones(4, np.float32)) + 2.0
+    v = w.pull_sync(keys)
+    assert np.allclose(v, base), f"rank {rank}: restored values wrong"
+    moved = owned_by_proc(srv, 0, 6)
+    if rank == 1:
+        assert (srv.ab.owner[moved] >= 0).all(), \
+            "adapted ownership lost in restore"
+    if rank == 0:
+        assert (srv.ab.owner[moved] == REMOTE).all()
+        assert (srv.glob.owner_hint[moved] == 1).all(), \
+            "manager table lost in restore"
+        assert (srv.ab.cache_slot[w.shard, moved[:2]] != NO_SLOT).any(), \
+            "cross-process replicas lost in restore"
+    srv.barrier()
+    # the restored manager still satisfies eventual consistency
+    w.wait(w.push(keys, np.ones((48, 4), np.float32)))
+    w.wait(w.push(keys, -np.ones((48, 4), np.float32)))
+    w.wait_all()
+    srv.wait_sync()
+    srv.barrier()
+    srv.wait_sync()
+    srv.barrier()
+    v = w.pull_sync(keys)
+    assert np.allclose(v, base, atol=1e-4), f"rank {rank}: not consistent"
+    srv.shutdown()
+    print(f"MP-OK ckpt_restore rank={rank}")
+
+
 SCENARIOS = {
     "pullpush": scenario_pullpush,
     "intent_locality": scenario_intent_locality,
     "monotonic": scenario_monotonic,
     "eventual": scenario_eventual,
     "location_caches": scenario_location_caches,
+    "ckpt_save": scenario_ckpt_save,
+    "ckpt_restore": scenario_ckpt_restore,
 }
 
 if __name__ == "__main__":

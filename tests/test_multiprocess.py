@@ -80,6 +80,19 @@ def test_mp_location_caches_on():
 
 
 @pytest.mark.slow
+def test_mp_checkpoint_crash_recovery(tmp_path):
+    """Distributed checkpoint + whole-job restart: per-rank shards restore
+    values, adapted placement (cross-process relocations/replicas), and
+    the consistency invariant in a FRESH launch (VERDICT r2 item 8)."""
+    path = str(tmp_path / "ck")
+    run_mp(2, "ckpt_save", args=(path,))
+    assert os.path.exists(path + ".manifest.npz")
+    assert os.path.exists(path + ".rank0.npz")
+    assert os.path.exists(path + ".rank1.npz")
+    run_mp(2, "ckpt_restore", args=(path,))
+
+
+@pytest.mark.slow
 def test_mp_location_caches_off():
     """--sys.location_caches 0: hint table stays cold, routing still
     converges via the manager."""

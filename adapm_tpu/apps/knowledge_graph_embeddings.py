@@ -347,9 +347,11 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--synthetic_triples", type=int, default=1500)
     parser.add_argument("--lookahead", type=int, default=4,
                         help="intent/sample batches ahead (kge.cc :1059)")
-    parser.add_argument("--device_routes", action="store_true",
+    parser.add_argument("--device_routes",
+                        action=argparse.BooleanOptionalAction, default=True,
                         help="device-routed fused step + on-device "
-                             "negative sampling (TPU hot path)")
+                             "negative sampling (TPU hot path; default on,"
+                             " --no-device_routes for host routing)")
     parser.add_argument("--init_scheme", default="normal",
                         choices=["normal", "uniform"])
     parser.add_argument("--init_scale", type=float, default=0.1)

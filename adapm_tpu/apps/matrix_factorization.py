@@ -24,7 +24,7 @@ import numpy as np
 
 from ..io import mf as mfio
 from ..models.mf import make_mf_loss
-from ..ops import FusedStepRunner
+from ..ops import DeviceRoutedRunner, FusedStepRunner
 from ..utils import Stopwatch, alog
 from .common import (KeyMapper, RuntimeGuard, add_common_arguments,
                      enforce_full_replication, epoch_report, make_server,
@@ -75,6 +75,18 @@ def run(args) -> float:
         srv, make_mf_loss(args.l2), role_class={"w": 0, "h": 0},
         role_dim={"w": rank, "h": rank})
 
+    # --device_routes: routing tables mirrored into HBM, host ships only
+    # the raw key batch per step (TPU hot path; ops/fused.py)
+    dev_runners = {}
+
+    def device_runner(shard: int) -> DeviceRoutedRunner:
+        if shard not in dev_runners:
+            dev_runners[shard] = DeviceRoutedRunner(
+                srv, make_mf_loss(args.l2), role_class={"w": 0, "h": 0},
+                role_dim={"w": rank, "h": rank}, shard=shard,
+                seed=args.seed + shard)
+        return dev_runners[shard]
+
     part = mfio.partition_points(rows, num_workers, m)
     by_worker = [np.nonzero(part == w)[0] for w in range(num_workers)]
     B = args.batch_size
@@ -85,10 +97,11 @@ def run(args) -> float:
     watch = Stopwatch(start=True)
 
     def train_batch(w, idx):
-        keys_w = kmap(rows[idx])
-        keys_h = kmap(cols[idx] + m)
-        loss = runner({"w": keys_w, "h": keys_h},
-                      np.asarray(vals[idx]), lr, shard=w.shard)
+        roles = {"w": kmap(rows[idx]), "h": kmap(cols[idx] + m)}
+        if args.device_routes:
+            loss = device_runner(w.shard)(roles, np.asarray(vals[idx]), lr)
+        else:
+            loss = runner(roles, np.asarray(vals[idx]), lr, shard=w.shard)
         for _ in range(args.sync_rounds_per_step):
             srv.sync.run_round()
         w.advance_clock()
@@ -199,6 +212,8 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--adagrad_init", type=float, default=1e-6)
     parser.add_argument("--bold_inc", type=float, default=1.05)
     parser.add_argument("--bold_dec", type=float, default=0.5)
+    parser.add_argument("--device_routes", action="store_true",
+                        help="device-routed fused step (TPU hot path)")
     parser.add_argument("--init_w", default=None)
     parser.add_argument("--init_h", default=None)
     parser.add_argument("--export_prefix", default=None)

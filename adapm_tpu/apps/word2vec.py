@@ -23,9 +23,9 @@ from typing import List
 import numpy as np
 
 from ..io import text as textio
-from ..models.sgns import (build_unigram_table, sgns_loss, subsample_mask,
-                           syn0_key, syn1_key)
-from ..ops import FusedStepRunner
+from ..models.sgns import (build_alias_table, build_unigram_table,
+                           sgns_loss, subsample_mask, syn0_key, syn1_key)
+from ..ops import DeviceRoutedRunner, FusedStepRunner
 from ..utils import Stopwatch, alog
 from .common import (KeyMapper, RuntimeGuard, add_common_arguments,
                      enforce_full_replication, epoch_report, make_server,
@@ -88,6 +88,24 @@ def run(args) -> float:
         role_dim={k: d for k in ("center", "ctx", "neg")})
 
     B, N = args.batch_size, args.negative
+
+    # --device_routes: negatives drawn IN-PROGRAM from the unigram^0.75
+    # alias table with a Local-scheme snap (the reference's negative table,
+    # word2vec.cc:125-144, as two O(V) HBM arrays); per step the host ships
+    # only the center/context key batch
+    dev_runners = {}
+
+    def device_runner(shard: int) -> DeviceRoutedRunner:
+        if shard not in dev_runners:
+            dev_runners[shard] = DeviceRoutedRunner(
+                srv, sgns_loss,
+                role_class={"center": 0, "ctx": 0, "neg": 0},
+                role_dim={k: d for k in ("center", "ctx", "neg")},
+                shard=shard, neg_role="neg", neg_shape=(B, N),
+                neg_population=kmap(syn1_key(np.arange(V))),
+                neg_alias=build_alias_table(counts),
+                seed=args.seed + shard)
+        return dev_runners[shard]
     guard = RuntimeGuard(args.max_runtime)
     watch = Stopwatch(start=True)
     mean_loss = 0.0
@@ -118,7 +136,8 @@ def run(args) -> float:
                 ks = np.unique(np.concatenate(
                     [kmap(syn0_key(c)), kmap(syn1_key(x))]))
                 w.intent(ks, fut, fut + 1)
-                h = w.prepare_sample(len(c) * N, fut, fut + 1)
+                h = None if args.device_routes else \
+                    w.prepare_sample(len(c) * N, fut, fut + 1)
                 prepared.append((pos, h, c, x))
 
             # prime the pipeline
@@ -130,22 +149,30 @@ def run(args) -> float:
                 if pos + args.readahead < len(my):
                     prepare(pos + args.readahead, ahead=args.readahead)
                 _, h, c, x = prepared.popleft()
-                if h is not None:
-                    negk = w.pull_sample_keys(h, len(c) * N)
-                    w.finish_sample(h)
+                if len(c):
+                    if h is not None:
+                        negk = w.pull_sample_keys(h, len(c) * N)
+                        w.finish_sample(h)
+                        buf_n.append(np.asarray(negk).reshape(len(c), N))
                     buf_c.append(kmap(syn0_key(c)))
                     buf_x.append(kmap(syn1_key(x)))
-                    buf_n.append(np.asarray(negk).reshape(len(c), N))
                     n_buf += len(c)
+
+                def step(cc, xx, nn):
+                    if args.device_routes:
+                        return device_runner(w.shard)(
+                            {"center": cc, "ctx": xx}, None, args.lr)
+                    return runner({"center": cc, "ctx": xx, "neg": nn},
+                                  None, args.lr, shard=w.shard)
+
                 while n_buf >= B:
                     cc = np.concatenate(buf_c)
                     xx = np.concatenate(buf_x)
-                    nn = np.concatenate(buf_n)
-                    loss = runner({"center": cc[:B], "ctx": xx[:B],
-                                   "neg": nn[:B]}, None, args.lr,
-                                  shard=w.shard)
-                    losses.append(loss)
-                    buf_c, buf_x, buf_n = [cc[B:]], [xx[B:]], [nn[B:]]
+                    nn = np.concatenate(buf_n) if buf_n else None
+                    losses.append(step(cc[:B], xx[:B],
+                                       None if nn is None else nn[:B]))
+                    buf_c, buf_x = [cc[B:]], [xx[B:]]
+                    buf_n = [] if nn is None else [nn[B:]]
                     n_buf -= B
                     for _ in range(args.sync_rounds_per_step):
                         srv.sync.run_round()
@@ -154,13 +181,11 @@ def run(args) -> float:
             if n_buf > 0:
                 cc = np.concatenate(buf_c)
                 xx = np.concatenate(buf_x)
-                nn = np.concatenate(buf_n)
+                nn = np.concatenate(buf_n) if buf_n else None
                 reps = -(-B // len(cc))
-                loss = runner({"center": np.tile(cc, reps)[:B],
-                               "ctx": np.tile(xx, reps)[:B],
-                               "neg": np.tile(nn, (reps, 1))[:B]},
-                              None, args.lr, shard=w.shard)
-                losses.append(loss)
+                losses.append(step(
+                    np.tile(cc, reps)[:B], np.tile(xx, reps)[:B],
+                    None if nn is None else np.tile(nn, (reps, 1))[:B]))
         srv.quiesce()
         mean_loss = float(np.mean([float(l) for l in losses])) \
             if losses else 0.0
@@ -204,6 +229,9 @@ def build_parser() -> argparse.ArgumentParser:
                              "(word2vec.cc --sample; 0 disables)")
     parser.add_argument("--readahead", type=int, default=1000,
                         help="sentences of intent/sample lookahead")
+    parser.add_argument("--device_routes", action="store_true",
+                        help="device-routed fused step + in-program "
+                             "unigram^0.75 negatives (TPU hot path)")
     parser.add_argument("--adagrad_init", type=float, default=1e-6)
     parser.add_argument("--export_prefix", default=None)
     add_common_arguments(parser)

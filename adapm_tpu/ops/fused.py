@@ -217,7 +217,8 @@ def make_device_routed_step(loss_fn: Callable[..., jnp.ndarray],
                             frozen_roles: Sequence[str] = (),
                             neg_role: str = None,
                             neg_shape: Tuple[int, ...] = None,
-                            no_replicas: bool = False):
+                            no_replicas: bool = False,
+                            neg_alias: bool = False):
     """Fused step that resolves routing in-program from device table
     mirrors. Signature of the returned step:
 
@@ -234,6 +235,14 @@ def make_device_routed_step(loss_fn: Callable[..., jnp.ndarray],
     are DRAWN in-program: uniform positions into local_index — the Local
     sampling scheme (core/sampling.py LocalSampling) executed on device.
 
+    `neg_alias=True` switches the draw to a NON-uniform app distribution:
+    the step takes an extra `alias` argument (prob[V], alias[V], key[V]
+    device arrays — a Vose table, models/sgns.py build_alias_table, e.g.
+    unigram^0.75 for word2vec) and draws candidate keys from it, then
+    SNAPS each to the nearest locally-resident key via a searchsorted
+    probe — the device twin of LocalSampling._snap (binary search replaces
+    the reference's linear probe, sampling.h:476-505).
+
     `no_replicas=True` compiles the replica-free specialization: reads touch
     only the main pool (1/3 of the gather traffic) and updates scatter only
     into main. Legal exactly while this shard holds zero replicas — the
@@ -245,9 +254,25 @@ def make_device_routed_step(loss_fn: Callable[..., jnp.ndarray],
     trainable = [r for r in roles if r not in frozen_roles]
 
     @partial(jax.jit, donate_argnums=(0,))
-    def step(pools, tables, keys, local_index, rng_key, aux, lr, eps):
+    def step(pools, tables, keys, local_index, alias, rng_key, aux, lr,
+             eps):
         keys = dict(keys)
-        if neg_role is not None and local_index is not None:
+        if neg_role is not None and neg_alias:
+            prob, alias_t, key_table = alias
+            k1, k2 = jax.random.split(rng_key)
+            u = jax.random.randint(k1, neg_shape, 0, prob.shape[0])
+            v = jax.random.uniform(k2, neg_shape)
+            cand = key_table[jnp.where(v < prob[u], u, alias_t[u])]
+            if local_index is not None:
+                # Local-scheme snap: padded index is sorted with an
+                # int-max sentinel tail, so searchsorted lands in
+                # [0, count] and wraps (sampling.h:494)
+                idx, count = local_index
+                pos = jnp.searchsorted(idx, cand)
+                pos = jnp.where(pos >= count, 0, pos)
+                cand = idx[pos]
+            keys[neg_role] = cand
+        elif neg_role is not None and local_index is not None:
             idx, count = local_index  # padded index + valid count
             pos = jax.random.randint(rng_key, neg_shape, 0, count)
             keys[neg_role] = idx[pos]
@@ -307,13 +332,29 @@ class DeviceRoutedRunner:
                  role_dim: Dict[str, int], shard: int = 0,
                  frozen_roles: Sequence[str] = (), neg_role: str = None,
                  neg_shape: Tuple[int, ...] = None,
-                 neg_population=None, seed: int = 0):
+                 neg_population=None, neg_alias=None, seed: int = 0):
+        """`neg_alias=(prob, alias)` (models/sgns.py build_alias_table)
+        switches on-device negative sampling to the app's non-uniform
+        distribution over `neg_population` (position i of the population
+        is drawn with prob ~ weight i), with a Local-scheme snap to
+        locally-resident keys."""
         self.server = server
         self.shard = shard
         self.role_class = role_class
         self.router = DeviceRouter(server, shard)
         self.neg_role = neg_role
         self._rng = jax.random.PRNGKey(seed)
+        self._alias = None
+        if neg_alias is not None:
+            assert neg_role is not None and neg_population is not None, \
+                "neg_alias needs neg_role and neg_population"
+            prob, alias = neg_alias
+            key_table = np.asarray(neg_population,
+                                   dtype=_key_dtype(server.num_keys))
+            assert len(prob) == len(key_table), \
+                "alias table must cover the population"
+            self._alias = (jnp.asarray(prob), jnp.asarray(alias),
+                           jnp.asarray(key_table))
         # population the device sampler may draw from (Local scheme: the
         # locally-resident slice of the allowed keys); None -> all keys
         self._neg_population = None if neg_population is None else \
@@ -328,7 +369,8 @@ class DeviceRoutedRunner:
         self._li_version = -1
         mk = lambda nr: make_device_routed_step(  # noqa: E731
             loss_fn, role_class, role_dim, shard, frozen_roles,
-            neg_role=neg_role, neg_shape=neg_shape, no_replicas=nr)
+            neg_role=neg_role, neg_shape=neg_shape, no_replicas=nr,
+            neg_alias=self._alias is not None)
         self.step_fn = mk(False)
         # replica-free specialization: 1/3 the gather traffic; selected per
         # step while this shard holds no replicas
@@ -348,7 +390,9 @@ class DeviceRoutedRunner:
     def _local_neg_index(self):
         """(padded index [capacity], valid count) — padded to a power-of-two
         capacity so placement changes don't change the jit shape (only a
-        capacity doubling recompiles)."""
+        capacity doubling recompiles). The index is sorted and the padding
+        tail carries the dtype max so the alias path's searchsorted snap
+        stays within the valid prefix."""
         srv = self.server
         if self._li_version == srv.topology_version and \
                 self._local_index is not None:
@@ -364,7 +408,8 @@ class DeviceRoutedRunner:
         if len(idx) == 0:
             idx = pop  # nothing local: draw from the full population
         cap = bucket_size(len(idx), minimum=64)
-        padded = np.zeros(cap, dtype=_key_dtype(srv.num_keys))
+        kdt = _key_dtype(srv.num_keys)
+        padded = np.full(cap, np.iinfo(kdt).max, dtype=kdt)
         padded[: len(idx)] = idx
         self._local_index = (jnp.asarray(padded),
                              jnp.int32(len(idx)))
@@ -404,7 +449,7 @@ class DeviceRoutedRunner:
             fn = self.step_fn if self._shard_has_replicas() \
                 else self._step_fn_norep
             pools, loss = fn(
-                pools, tables, keys, local_index, sub, aux,
+                pools, tables, keys, local_index, self._alias, sub, aux,
                 jnp.float32(lr), jnp.float32(eps))
             for st, (m, c, d) in zip(srv.stores, pools):
                 st.main, st.cache, st.delta = m, c, d

@@ -83,28 +83,31 @@ def test_word2vec_subsampling(tmp_path):
 
 @pytest.mark.parametrize("model", ["complex", "rescal"])
 def test_kge_app(model):
+    """Host-routed path (--no-device_routes): exercises the full
+    prepare_sample/pull_sample machinery; device routing is the default."""
     from adapm_tpu.apps import knowledge_graph_embeddings as kge
     args = kge.build_parser().parse_args(
         ["--model", model, "--dim", "8", "--neg_ratio", "2",
          "--synthetic_entities", "60", "--synthetic_relations", "4",
          "--synthetic_triples", "400", "--epochs", "6", "--batch_size", "32",
-         "--lr", "0.2", "--eval_every", "6", "--eval_triples", "60"] + FAST)
+         "--lr", "0.2", "--eval_every", "6", "--eval_triples", "60",
+         "--no-device_routes"] + FAST)
     result = kge.run_app(args)
     # random MRR over 60 entities ~ 0.07; the synthetic KG is near-functional
     # (s, r) -> o, so even 2 epochs must clearly beat random
     assert result["mrr"] > 0.15, result
 
 
-def test_kge_device_routes():
-    """--device_routes: the TPU hot path (in-program routing + on-device
-    Local-scheme negative sampling) trains to the same quality."""
+def test_kge_device_routes_default():
+    """Device routing (the default): in-program routing + on-device
+    Local-scheme negative sampling trains to the same quality."""
     from adapm_tpu.apps import knowledge_graph_embeddings as kge
     args = kge.build_parser().parse_args(
         ["--dim", "8", "--neg_ratio", "2", "--synthetic_entities", "60",
          "--synthetic_relations", "4", "--synthetic_triples", "400",
          "--epochs", "4", "--batch_size", "32", "--lr", "0.2",
-         "--eval_every", "4", "--eval_triples", "60",
-         "--device_routes"] + FAST)
+         "--eval_every", "4", "--eval_triples", "60"] + FAST)
+    assert args.device_routes, "device routing must be the KGE default"
     result = kge.run_app(args)
     assert result["mrr"] > 0.12, result
 

@@ -1,0 +1,81 @@
+"""Reproducible quality-parity harness on the reference's OWN bundled
+datasets (/root/reference/apps/data — the exact files its CI trains on,
+tests/run_apps.sh:3-13). Pins the quality floors recorded in BASELINE.md so
+the parity evidence is one `pytest -m parity` away instead of a manual run
+(VERDICT r2 item 4).
+
+Floors are set well below the typical results (KGE toy MRR ~0.44, MF loss
+~650 after 4 epochs) but far above chance, so they fail on a real
+regression without flaking on seed wiggle.
+"""
+import os
+
+import numpy as np
+import pytest
+
+REF_DATA = "/root/reference/apps/data"
+FAST = ["--sys.sync.max_per_sec", "0"]
+
+pytestmark = [
+    pytest.mark.parity,
+    pytest.mark.slow,
+    pytest.mark.skipif(not os.path.isdir(REF_DATA),
+                       reason="reference data not present"),
+]
+
+
+def test_parity_kge_complex_toy():
+    """Reference CI config (run_apps.sh): 280 entities, 112 relations,
+    dim 10, 4 epochs. BASELINE.md records test filtered MRR 0.445 /
+    Hits@10 0.727 (random ~0.02); floor at MRR >= 0.30, Hits@10 >= 0.55."""
+    from adapm_tpu.apps import knowledge_graph_embeddings as kge
+    args = kge.build_parser().parse_args(
+        ["--train", f"{REF_DATA}/kge/train.del",
+         "--valid", f"{REF_DATA}/kge/valid.del",
+         "--test", f"{REF_DATA}/kge/test.del",
+         "--num_entities", "280", "--num_relations", "112",
+         "--model", "complex", "--dim", "10", "--neg_ratio", "4",
+         "--epochs", "4", "--batch_size", "16", "--lr", "0.5",
+         "--eval_every", "4", "--eval_triples", "2000",
+         "--init_scheme", "uniform", "--init_scale", "1.0"] + FAST)
+    result = kge.run_app(args)
+    assert result["test_mrr"] >= 0.30, result
+    assert result["test_hits10"] >= 0.55, result
+    assert np.isfinite(result["loss"])
+
+
+@pytest.mark.parametrize("algorithm", ["dsgd", "columnwise"])
+def test_parity_mf_toy(algorithm):
+    """Reference CI config: 6x4 toy matrix, both access orders. The data
+    file carries large entries (loss starts ~750); training must cut the
+    squared error well below the untrained start (BASELINE.md: 751 -> 652
+    in 4 epochs at rank 10; with more epochs it keeps falling)."""
+    from adapm_tpu.apps import matrix_factorization as mf
+    from adapm_tpu.io.mf import read_coo
+    _, _, vals, _, _ = read_coo(f"{REF_DATA}/mf/train.mmc")
+    start = float((vals ** 2).sum())
+    args = mf.build_parser().parse_args(
+        ["--data", f"{REF_DATA}/mf/train.mmc", "--rank", "10",
+         "--epochs", "10", "--batch_size", "8", "--lr", "0.05",
+         "--algorithm", algorithm] + FAST)
+    loss = mf.run(args)
+    assert np.isfinite(loss)
+    assert loss < 0.95 * start, (loss, start)
+
+
+def test_parity_word2vec_small():
+    """Reference CI config: lm/small.txt, SGNS. The pipeline (readahead
+    intent + PrepareSample negatives) must run on the real corpus and the
+    sigmoid-CE loss must fall below the untrained level (~ln2 * (1+neg)
+    per token pair ~ 4.16 for neg=5; BASELINE.md records 2.79 after one
+    epoch)."""
+    from adapm_tpu.apps import word2vec as w2v
+    args = w2v.build_parser().parse_args(
+        ["--data", f"{REF_DATA}/lm/small.txt", "--dim", "32",
+         "--window", "5", "--negative", "5", "--epochs", "1",
+         "--batch_size", "512", "--lr", "0.05",
+         "--readahead", "200"] + FAST)
+    loss = w2v.run(args)
+    assert np.isfinite(loss)
+    untrained = np.log(2.0) * (1 + 5)
+    assert loss < 0.85 * untrained, loss

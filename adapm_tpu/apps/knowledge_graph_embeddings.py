@@ -250,8 +250,9 @@ def run_app(args) -> dict:
     result = {}
 
     for epoch in range(args.epochs):
-        epoch_loss = 0.0
-        nbatches = 0
+        # losses stay device scalars until epoch end: a float() per step
+        # would serialize host and device (docs/PERF.md gap analysis)
+        epoch_losses = []
         for wi, w in enumerate(workers):
             mine = parts[wi]
             batches = [mine[idx] for idx in
@@ -284,13 +285,14 @@ def run_app(args) -> dict:
                     w.finish_sample(handles.pop(bi))
                     roles["neg"] = neg
                     loss = run.runner(roles, None, args.lr, shard=w.shard)
-                epoch_loss += float(loss)
-                nbatches += 1
+                epoch_losses.append(loss)
                 for _ in range(args.sync_rounds_per_step):
                     srv.sync.run_round()
                 w.advance_clock()
         srv.quiesce()
 
+        epoch_loss = float(np.sum([float(l) for l in epoch_losses]))
+        nbatches = len(epoch_losses)
         # loss aggregation through the PS loss key (ps_allreduce idiom)
         total = run.allreduce(run.loss_key_l,
                               np.array([epoch_loss / max(nbatches, 1)]))

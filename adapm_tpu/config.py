@@ -30,6 +30,10 @@ class SystemOptions:
     # -- intent action timing (sys.time_intent_actions): ActionTimer on/off
     time_intent_actions: bool = True
 
+    # -- heartbeat (reference PS_HEARTBEAT_INTERVAL, src/van.cc:515-527;
+    #    0 = off, matching the reference's default)
+    heartbeat_s: float = 0.0
+
     # -- sync throttling (sys.sync.*)
     sync_max_per_sec: float = 1000.0
     sync_pause_ms: float = 0.0
@@ -67,6 +71,8 @@ class SystemOptions:
                        type=int, default=1)
         g.add_argument("--sys.time_intent_actions", dest="sys_time_intent_actions",
                        type=int, default=1)
+        g.add_argument("--sys.heartbeat", dest="sys_heartbeat",
+                       type=float, default=0.0)
         g.add_argument("--sys.sync.max_per_sec", dest="sys_sync_max_per_sec",
                        type=float, default=1000.0)
         g.add_argument("--sys.sync.pause", dest="sys_sync_pause", type=float,
@@ -98,6 +104,7 @@ class SystemOptions:
             channels=args.sys_channels,
             location_caches=bool(args.sys_location_caches),
             time_intent_actions=bool(args.sys_time_intent_actions),
+            heartbeat_s=args.sys_heartbeat,
             sync_max_per_sec=args.sys_sync_max_per_sec,
             sync_pause_ms=args.sys_sync_pause,
             sync_threshold=args.sys_sync_threshold,

@@ -132,6 +132,9 @@ class Server:
         if self.num_procs > 1:
             from ..parallel.pm import GlobalPM
             self.glob = GlobalPM(self)
+            if self.opts.heartbeat_s > 0:
+                from ..parallel import control
+                control.start_heartbeat(self.opts.heartbeat_s)
 
         self.sampling = None  # set by enable_sampling_support
 
@@ -706,11 +709,19 @@ class Server:
             for s in self.stores:
                 s.block()
 
+    def dead_nodes(self, max_age_s: float = 10.0) -> list:
+        """Peer processes whose heartbeat has gone stale (reference
+        Postoffice::GetDeadNodes; requires --sys.heartbeat > 0)."""
+        from ..parallel import control
+        return control.dead_processes(max_age_s)
+
     def shutdown(self) -> None:
         self.stop_sync_thread()
         self.block()
         self.write_stats()
         if self.glob is not None:
+            from ..parallel import control
+            control.stop_heartbeat()
             self.glob.shutdown()
 
     def locality_summary(self) -> Dict[str, float]:

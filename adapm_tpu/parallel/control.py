@@ -91,6 +91,71 @@ def barrier(name: str = "adapm") -> None:
     multihost_utils.sync_global_devices(name)
 
 
+_hb_stop = None
+
+
+def start_heartbeat(interval_s: float = 2.0) -> None:
+    """Publish a periodic liveness beat to the coordinator's KV store
+    (reference Van heartbeats, src/van.cc:515-527; off by default there
+    and opt-in here). No-op in a single process."""
+    import threading
+    import time as _time
+
+    import jax
+    if jax.process_count() == 1:
+        return
+    global _hb_stop
+    if _hb_stop is not None:
+        return
+    from jax._src import distributed
+    client = distributed.global_state.client
+    pid = jax.process_index()
+    _hb_stop = threading.Event()
+
+    def loop():
+        while True:
+            client.key_value_set(f"adapm/hb/{pid}",
+                                 str(_time.time()), allow_overwrite=True)
+            if _hb_stop.wait(interval_s):
+                return
+
+    threading.Thread(target=loop, daemon=True,
+                     name="adapm-heartbeat").start()
+
+
+def stop_heartbeat() -> None:
+    global _hb_stop
+    if _hb_stop is not None:
+        _hb_stop.set()
+        _hb_stop = None
+
+
+def dead_processes(max_age_s: float = 10.0) -> list:
+    """Process ids whose last heartbeat is older than `max_age_s` (the
+    reference's Postoffice::GetDeadNodes, src/postoffice.cc:202-221).
+    Processes that never published a beat are not reported (heartbeats
+    are opt-in, as in the reference). Empty in a single process."""
+    import time as _time
+
+    import jax
+    if jax.process_count() == 1:
+        return []
+    from jax._src import distributed
+    client = distributed.global_state.client
+    now = _time.time()
+    dead = []
+    for p in range(jax.process_count()):
+        if p == jax.process_index():
+            continue
+        try:
+            beat = client.key_value_try_get(f"adapm/hb/{p}")
+        except Exception:  # noqa: BLE001 — no beat published yet
+            continue
+        if now - float(beat) > max_age_s:
+            dead.append(p)
+    return dead
+
+
 def allreduce(values, op: str = "sum") -> np.ndarray:
     """Sum/mean/max a host scalar or vector across processes (reference
     ps_allreduce, include/utils.h:163-197: push to a shared PS key, barrier,

@@ -1,10 +1,19 @@
-"""Headline benchmark: fused KGE ComplEx training throughput (triples/sec).
+"""Headline benchmark: KGE ComplEx training throughput (triples/sec)
+through the PARAMETER MANAGER — not the bare kernel.
 
 The reference's headline workload is ComplEx KGE training (README.md:140-159;
-BASELINE.json north star: beat AdaPM-CPU 8-node wall-clock). This bench runs
-the framework's fused train step (gather -> ComplEx score/grad -> AdaGrad ->
-scatter-add on the sharded HBM pools, ops/fused.py) on the available device
-and reports triples/sec.
+BASELINE.json north star: beat AdaPM-CPU 8-node wall-clock). The timed loop
+runs the full PM step the apps run: skewed (power-law) key batches, intent
+signaling for the next batch, a planner round (`sync.run_round`) every step,
+and the fused gather -> ComplEx score/grad -> AdaGrad -> scatter-add program
+on the sharded HBM pools (ops/fused.py, device-routed).
+
+A single chip is one shard, so every key is local in the timed loop — the
+best case adaptive management aims for. The adaptive machinery itself
+(replication, relocation, delta sync) is exercised in a separate 8-virtual-
+shard phase whose stats (replicas_created, keys_synced, relocations > 0) are
+reported in the same JSON line, plus a word2vec SGNS step benchmark and the
+key-dedup lever measurement (docs/PERF.md "Levers").
 
 vs_baseline: the reference publishes no in-tree numbers and its binary
 cannot be built in this image (ZMQ/Boost/Eigen absent, installs forbidden —
@@ -13,20 +22,34 @@ this host: a strong batched torch-CPU implementation of the same step,
 per-core, scaled x64 for the paper's 8 nodes x 8 worker threads.
 vs_baseline = tpu_triples_per_sec / (64 * torch_cpu_per_core_triples_per_sec).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "pm",
+"w2v_pairs_per_sec", "dedup"}.
 """
 from __future__ import annotations
 
 import json
+import os
 import time
+
+# the adaptive phase runs on 8 virtual CPU shards in the same process;
+# must be set before jax initializes its backends
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
 
 import numpy as np
 
 
-def bench_tpu(E=200_000, R=1_000, d=128, B=4096, N=32, steps=50,
-              warmup=5) -> float:
-    import jax
+def _skewed_keys(rng, n, size):
+    """Power-law key skew (embedding workloads are zipfian): a realistic
+    mix of hot and cold rows for the gather/scatter."""
+    return (n * rng.random(size) ** 3).astype(np.int64).clip(0, n - 1)
 
+
+def bench_tpu(E=200_000, R=1_000, d=128, B=4096, N=32, steps=50,
+              warmup=5, dedup_batches=False):
+    """Returns (triples/sec, server) — the caller reads PM stats."""
     import adapm_tpu
     from adapm_tpu.config import SystemOptions
     from adapm_tpu.models import make_kge_loss
@@ -34,7 +57,8 @@ def bench_tpu(E=200_000, R=1_000, d=128, B=4096, N=32, steps=50,
 
     num_keys = E + R
     srv = adapm_tpu.setup(num_keys, 4 * d,
-                          opts=SystemOptions(cache_slots_per_shard=1))
+                          opts=SystemOptions(cache_slots_per_shard=1,
+                                             sync_max_per_sec=0))
     w = srv.make_worker(0)
     rng = np.random.default_rng(0)
     # initialize in slabs to bound host memory
@@ -57,29 +81,154 @@ def bench_tpu(E=200_000, R=1_000, d=128, B=4096, N=32, steps=50,
         neg_population=np.arange(E))
 
     def batch():
-        return {
-            "s": rng.integers(0, E, B).astype(np.int64),
+        b = {
+            "s": _skewed_keys(rng, E, B),
             "r": rng.integers(E, E + R, B).astype(np.int64),
-            "o": rng.integers(0, E, B).astype(np.int64),
+            "o": _skewed_keys(rng, E, B),
         }
+        if dedup_batches:
+            # dedup-lever upper bound: all-unique keys per role (what a
+            # perfect in-step dedup would achieve for gather/scatter rows)
+            for k in ("s", "o"):
+                b[k] = rng.permutation(E)[:B].astype(np.int64)
+        return b
+
+    batches = [batch() for _ in range(4)]
+    intent_keys = [np.unique(np.concatenate([b["s"], b["r"], b["o"]]))
+                   for b in batches]
+
+    def pm_step(i):
+        # the full app-step shape: intent for the NEXT batch, fused step,
+        # one planner round, clock tick
+        nxt = (i + 1) % len(batches)
+        w.intent(intent_keys[nxt], w.current_clock + 1, w.current_clock + 2)
+        loss = runner(batches[i % len(batches)], None, 0.1)
+        srv.sync.run_round()
+        w.advance_clock()
+        return loss
 
     # Slope timing: some remote-attached TPU runtimes acknowledge
     # block_until_ready before work completes; only a value fetch truly
     # syncs, at a large fixed RTT. Timing two loop lengths and taking the
     # slope removes both the RTT and any warmup from the estimate.
     assert steps >= 4, "slope timing needs steps >= 4 (two loop lengths)"
-    batches = [batch() for _ in range(4)]
 
     def timed(n: int) -> float:
         t0 = time.perf_counter()
         loss = None
         for i in range(n):
-            loss = runner(batches[i % len(batches)], None, 0.1)
+            loss = pm_step(i)
         float(loss)  # force completion of the whole donated chain
         return time.perf_counter() - t0
 
     for _ in range(warmup):
-        runner(batches[0], None, 0.1)
+        pm_step(0)
+    timed(1)
+    t_short = timed(steps // 4)
+    t_long = timed(steps)
+    dt = (t_long - t_short) / (steps - steps // 4)
+    return B / dt, srv
+
+
+def bench_adaptive_pm(E=20_000, d=32, B=1024, N=8, steps=30):
+    """Adaptive-management phase on an 8-virtual-shard CPU mesh: two
+    workers with overlapping skewed intents force replication, exclusive
+    tails force relocation, and per-step planner rounds ship deltas —
+    the machinery a multi-chip mesh exercises per step. Returns the sync
+    stats dict recorded for BENCH_r03."""
+    import jax
+
+    from adapm_tpu import Server
+    from adapm_tpu.config import SystemOptions
+    from adapm_tpu.models import make_kge_loss
+    from adapm_tpu.ops import FusedStepRunner
+    from adapm_tpu.parallel.mesh import MeshContext, Mesh
+
+    cpu = jax.devices("cpu")
+    mesh = MeshContext(Mesh(np.asarray(cpu), ("kv",)))
+    srv = Server(E + 64, 4 * d, ctx=mesh,
+                 opts=SystemOptions(sync_max_per_sec=0,
+                                    cache_slots_per_shard=4096))
+    ws = [srv.make_worker(i) for i in range(2)]
+    runner = FusedStepRunner(
+        srv, make_kge_loss("complex"),
+        role_class={"s": 0, "r": 0, "o": 0, "neg": 0},
+        role_dim={k: 2 * d for k in ("s", "r", "o", "neg")})
+    rng = np.random.default_rng(1)
+    t0 = time.perf_counter()
+    for i in range(steps):
+        for wi, w in enumerate(ws):
+            # hot head shared by both workers (-> replication), disjoint
+            # cold tails per worker (-> relocation)
+            hot = _skewed_keys(rng, 2_000, B // 2)
+            cold = rng.integers(2_000 + wi * 9_000,
+                                2_000 + (wi + 1) * 9_000, B // 2)
+            s = np.concatenate([hot, cold])
+            batch = {"s": s, "r": np.full(B, E + wi, np.int64),
+                     "o": _skewed_keys(rng, E, B),
+                     "neg": _skewed_keys(rng, E, B * N).reshape(B, N)}
+            w.intent(np.unique(s), w.current_clock + 1,
+                     w.current_clock + 3)
+            runner(batch, None, 0.05, shard=w.shard)
+            w.advance_clock()
+        srv.sync.run_round(all_channels=(i % 4 == 0))
+    srv.quiesce()
+    dt = time.perf_counter() - t0
+    s = srv.sync.stats
+    out = {"replicas_created": s.replicas_created,
+           "replicas_dropped": s.replicas_dropped,
+           "relocations": s.relocations,
+           "keys_synced": s.keys_synced,
+           "intents_processed": s.intents_processed,
+           "adaptive_steps_per_sec": round(2 * steps / dt, 1)}
+    srv.shutdown()
+    return out
+
+
+def bench_w2v(V=100_000, d=128, B=8192, N=5, steps=40, warmup=4) -> float:
+    """word2vec SGNS fused-step throughput (pairs/sec) with on-device
+    unigram^0.75 alias negatives — the second headline workload."""
+    import adapm_tpu
+    from adapm_tpu.config import SystemOptions
+    from adapm_tpu.models.sgns import build_alias_table, sgns_loss, \
+        syn1_key
+    from adapm_tpu.ops import DeviceRoutedRunner
+
+    num_keys = 2 * V
+    srv = adapm_tpu.setup(num_keys, 2 * d,
+                          opts=SystemOptions(cache_slots_per_shard=1,
+                                             sync_max_per_sec=0))
+    w = srv.make_worker(0)
+    rng = np.random.default_rng(0)
+    slab = 100_000
+    for lo in range(0, num_keys, slab):
+        hi = min(lo + slab, num_keys)
+        vals = rng.normal(size=(hi - lo, 2 * d)).astype(np.float32) * 0.05
+        vals[:, d:] = 1e-6
+        w.set(np.arange(lo, hi), vals)
+    srv.block()
+    counts = 1.0 / (np.arange(V) + 10.0)  # zipf corpus frequencies
+    runner = DeviceRoutedRunner(
+        srv, sgns_loss, role_class={"center": 0, "ctx": 0, "neg": 0},
+        role_dim={k: d for k in ("center", "ctx", "neg")},
+        neg_role="neg", neg_shape=(B, N),
+        neg_population=syn1_key(np.arange(V)),
+        neg_alias=build_alias_table(counts))
+
+    batches = [{"center": 2 * _skewed_keys(rng, V, B),
+                "ctx": 2 * _skewed_keys(rng, V, B) + 1}
+               for _ in range(4)]
+
+    def timed(n):
+        t0 = time.perf_counter()
+        loss = None
+        for i in range(n):
+            loss = runner(batches[i % 4], None, 0.05)
+        float(loss)
+        return time.perf_counter() - t0
+
+    for _ in range(warmup):
+        runner(batches[0], None, 0.05)
     timed(1)
     t_short = timed(steps // 4)
     t_long = timed(steps)
@@ -148,7 +297,19 @@ def bench_cpu_torch(E=200_000, R=1_000, d=128, B=4096, N=32,
 
 
 def main():
-    tput = bench_tpu()
+    tput, srv = bench_tpu()
+    kernel_stats = {
+        "rounds": srv.sync.stats.rounds,
+        "intents_processed": srv.sync.stats.intents_processed,
+    }
+    srv.shutdown()
+    # dedup lever (docs/PERF.md): all-unique batches bound what a perfect
+    # in-step dedup could gain over the skewed batches
+    tput_unique, srv2 = bench_tpu(steps=24, dedup_batches=True)
+    srv2.shutdown()
+    pm = bench_adaptive_pm()
+    pm.update(kernel_stats)
+    w2v = bench_w2v()
     # measured per-core CPU throughput of a strong batched torch
     # implementation of the same step; the paper's 8-node x 8-thread
     # cluster is modeled as 64 such cores (conservative: AdaPM's
@@ -159,10 +320,15 @@ def main():
     cpu = bench_cpu_torch()
     baseline = 64.0 * cpu
     print(json.dumps({
-        "metric": "kge_complex_train_throughput",
+        "metric": "kge_complex_train_throughput_pm",
         "value": round(tput, 1),
-        "unit": "triples/sec (d=128, B=4096, N=32 negs, E=200k)",
+        "unit": "triples/sec through the PM (intent+sync in loop; "
+                "d=128, B=4096, N=32 negs, E=200k, power-law skew)",
         "vs_baseline": round(tput / baseline, 3),
+        "pm": pm,
+        "w2v_pairs_per_sec": round(w2v, 1),
+        "dedup": {"unique_batch_triples_per_sec": round(tput_unique, 1),
+                  "gain_vs_skewed": round(tput_unique / tput - 1.0, 3)},
     }))
 
 

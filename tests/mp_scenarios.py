@@ -307,6 +307,33 @@ def scenario_ckpt_restore():
     print(f"MP-OK ckpt_restore rank={rank}")
 
 
+def scenario_heartbeat():
+    """Heartbeat + dead-node detection (reference van heartbeats +
+    Postoffice::GetDeadNodes): rank 1 stops beating; rank 0 must report it
+    dead within the age window, while a beating rank stays undetected."""
+    import time
+    srv = adapm_tpu.setup(16, 4, opts=SystemOptions(
+        sync_max_per_sec=0, heartbeat_s=0.3))
+    rank = control.process_id()
+    time.sleep(1.0)  # everyone has beaten at least once
+    assert srv.dead_nodes(max_age_s=5.0) == [], "live peers reported dead"
+    srv.barrier()
+    if rank == 1:
+        control.stop_heartbeat()
+    srv.barrier()
+    if rank == 0:
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            dead = srv.dead_nodes(max_age_s=1.5)
+            if dead == [1]:
+                break
+            time.sleep(0.3)
+        assert dead == [1], f"rank 1 not detected dead: {dead}"
+    srv.barrier()
+    srv.shutdown()
+    print(f"MP-OK heartbeat rank={rank}")
+
+
 SCENARIOS = {
     "pullpush": scenario_pullpush,
     "intent_locality": scenario_intent_locality,
@@ -315,6 +342,7 @@ SCENARIOS = {
     "location_caches": scenario_location_caches,
     "ckpt_save": scenario_ckpt_save,
     "ckpt_restore": scenario_ckpt_restore,
+    "heartbeat": scenario_heartbeat,
 }
 
 if __name__ == "__main__":

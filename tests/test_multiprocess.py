@@ -93,6 +93,13 @@ def test_mp_checkpoint_crash_recovery(tmp_path):
 
 
 @pytest.mark.slow
+def test_mp_heartbeat_dead_node_detection():
+    """--sys.heartbeat: a rank that stops beating is reported by
+    dead_nodes() (reference GetDeadNodes, src/postoffice.cc:202-221)."""
+    run_mp(2, "heartbeat")
+
+
+@pytest.mark.slow
 def test_mp_location_caches_off():
     """--sys.location_caches 0: hint table stays cold, routing still
     converges via the manager."""

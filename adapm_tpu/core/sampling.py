@@ -88,19 +88,31 @@ class SamplingBase:
 
     def _draw_wor(self, n: int, worker, seen: set) -> np.ndarray:
         """Draw without replacement against `seen` (rejection sampling,
-        reference draw_samples WOR, sampling.h:142-160)."""
-        out = []
-        tries = 0
-        while len(out) < n and tries < 100 * n + 100:
-            for k in self._draw(n - len(out), worker):
-                k = int(k)
-                tries += 1
-                if k not in seen:
-                    seen.add(k)
-                    out.append(k)
-        if len(out) < n:
+        reference draw_samples WOR, sampling.h:142-160). Batched: each
+        round draws all still-needed keys at once and filters collisions
+        vectorized (np.isin + first-occurrence), instead of the per-key
+        Python probe the reference's C++ can afford."""
+        out = np.empty(n, dtype=np.int64)
+        got = 0
+        seen_arr = np.fromiter(seen, np.int64, len(seen)) if seen else \
+            np.empty(0, dtype=np.int64)
+        for _ in range(200):
+            if got >= n:
+                break
+            cand = self._draw(n - got, worker)
+            # accept first occurrences not in seen (vectorized)
+            _, first = np.unique(cand, return_index=True)
+            ok = np.zeros(len(cand), dtype=bool)
+            ok[first] = True
+            ok &= ~np.isin(cand, seen_arr)
+            acc = cand[ok]
+            out[got:got + len(acc)] = acc
+            got += len(acc)
+            seen_arr = np.concatenate([seen_arr, acc])
+        if got < n:
             raise RuntimeError("WOR sampling could not find enough keys")
-        return np.asarray(out, dtype=np.int64)
+        seen.update(out.tolist())
+        return out
 
     # -- public (called via Worker) -----------------------------------------
 
@@ -197,12 +209,19 @@ class PoolSampling(SamplingBase):
         self.uses[idx] += 1
         keys = self.pool[idx].copy()
         if not self.opts.sampling_with_replacement:
-            # dedup within the handle by redrawing collisions directly
-            for i, k in enumerate(keys):
-                if int(k) in h.seen:
-                    keys[i] = int(self._draw_wor(1, worker, h.seen)[0])
-                else:
-                    h.seen.add(int(k))
+            # dedup within the handle: accept first occurrences not yet
+            # seen (one vectorized pass), redraw the collisions in one
+            # batched WOR call
+            seen_arr = np.fromiter(h.seen, np.int64, len(h.seen)) \
+                if h.seen else np.empty(0, dtype=np.int64)
+            _, first = np.unique(keys, return_index=True)
+            ok = np.zeros(len(keys), dtype=bool)
+            ok[first] = True
+            ok &= ~np.isin(keys, seen_arr)
+            h.seen.update(keys[ok].tolist())
+            bad = np.nonzero(~ok)[0]
+            if len(bad):
+                keys[bad] = self._draw_wor(len(bad), worker, h.seen)
         return keys
 
 
@@ -243,29 +262,42 @@ class LocalSampling(SamplingBase):
     def _pull_keys(self, worker, h: _Handle, n: int) -> np.ndarray:
         if self.opts.sampling_with_replacement:
             keys = self._snap(self._draw(n, worker), worker.shard)
-        else:
-            keys = np.empty(n, dtype=np.int64)
-            local = self._local_index(worker.shard)
-            for i in range(n):
-                k = int(self._snap(self._draw(1, worker), worker.shard)[0])
-                if k in h.seen:
-                    # collision: probe forward through the local index
-                    # (WOR variant, sampling.h:437-460)
-                    j = int(np.searchsorted(local, k))
-                    for step in range(1, len(local) + 1):
-                        k2 = int(local[(j + step) % len(local)])
-                        if k2 not in h.seen:
-                            k = k2
-                            break
-                    else:
-                        # every locally-available key is used up: fall back
-                        # to a global WOR draw (key may be remote — slower,
-                        # never wrong)
-                        k = int(self._draw_wor(1, worker, set(h.seen))[0])
-                h.seen.add(k)
-                keys[i] = k
+            self.stats["pulled_local"] += n
+            return keys
+        # WOR: batched draw+snap, then collisions probe FORWARD through
+        # the local index — all rounds vectorized (the reference probes
+        # per sample in C++, sampling.h:437-460; a Python per-sample loop
+        # is exactly what kills w2v-at-scale prepare/pull)
+        local = self._local_index(worker.shard)
+        out = np.empty(n, dtype=np.int64)
+        got = 0
+        if len(local):
+            seen_arr = np.fromiter(h.seen, np.int64, len(h.seen)) \
+                if h.seen else np.empty(0, dtype=np.int64)
+            # position of each pending sample's probe in the local index
+            probe = np.searchsorted(local, self._snap(
+                self._draw(n, worker), worker.shard))
+            probe = np.where(probe >= len(local), 0, probe)
+            for _ in range(len(local) + 1):
+                if got >= n:
+                    break
+                cand = local[probe]
+                _, first = np.unique(cand, return_index=True)
+                ok = np.zeros(len(cand), dtype=bool)
+                ok[first] = True
+                ok &= ~np.isin(cand, seen_arr)
+                acc = cand[ok]
+                out[got:got + len(acc)] = acc
+                got += len(acc)
+                seen_arr = np.concatenate([seen_arr, acc])
+                probe = (probe[~ok] + 1) % len(local)
+            h.seen.update(out[:got].tolist())
+        if got < n:
+            # local population exhausted: global WOR draws (keys may be
+            # remote — slower, never wrong)
+            out[got:] = self._draw_wor(n - got, worker, h.seen)
         self.stats["pulled_local"] += n
-        return keys
+        return out
 
 
 def make_sampling(server, sample_key_fn, min_key: int, max_key: int,

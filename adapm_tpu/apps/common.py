@@ -84,14 +84,30 @@ def enforce_full_replication(workers, num_keys: int) -> None:
 
 def worker0_init(workers, keys: np.ndarray, values: np.ndarray,
                  slab: int = 100_000) -> None:
-    """Worker 0 initializes the model inside BeginSetup/EndSetup (the
-    reference's standard init pattern); values is [len(keys), L]."""
+    """Worker 0 of PROCESS 0 initializes the model inside
+    BeginSetup/EndSetup (the reference's worker-0-initializes pattern;
+    under the launcher, cross-process Sets route to each key's owner)."""
+    from ..parallel import control
     w0 = workers[0]
     w0.begin_setup()
-    for lo in range(0, len(keys), slab):
-        hi = min(lo + slab, len(keys))
-        w0.set(keys[lo:hi], values[lo:hi])
-    w0.end_setup()
+    if control.process_id() == 0:
+        for lo in range(0, len(keys), slab):
+            hi = min(lo + slab, len(keys))
+            w0.set(keys[lo:hi], values[lo:hi])
+        w0.wait_all()
+    w0.end_setup()  # barriers: every rank sees the initialized model
+
+
+def global_worker_slices(n_items: int, num_local_workers: int):
+    """Per-local-worker contiguous slices of [0, n_items) partitioned over
+    ALL workers of ALL processes (reference apps partition data by global
+    worker id, word2vec.cc:524-531, kge.cc:968-970). Returns a list of
+    index arrays, one per local worker."""
+    from ..parallel import control
+    P, pid = control.num_processes(), control.process_id()
+    parts = np.array_split(np.arange(n_items), P * num_local_workers)
+    return [parts[pid * num_local_workers + wi]
+            for wi in range(num_local_workers)]
 
 
 def wrap_batches(n: int, batch_size: int, rng: Optional[np.random.Generator]
@@ -110,14 +126,25 @@ def wrap_batches(n: int, batch_size: int, rng: Optional[np.random.Generator]
 
 
 class RuntimeGuard:
-    """max_runtime cutoff (reference apps' --max_runtime)."""
+    """max_runtime cutoff (reference apps' --max_runtime). The decision is
+    COLLECTIVE in a multi-process run: every rank must leave the epoch
+    loop together or the per-epoch barriers deadlock."""
 
     def __init__(self, max_runtime_s: float):
         self.max = max_runtime_s
         self.watch = Stopwatch(start=True)
 
     def expired(self) -> bool:
-        return self.max > 0 and self.watch.elapsed_s > self.max
+        mine = self.max > 0 and self.watch.elapsed_s > self.max
+        from ..parallel import control
+        if control.num_processes() == 1:
+            return mine
+        return bool(control.allreduce(float(mine), "max")[0] > 0)
+
+
+def is_rank0() -> bool:
+    from ..parallel import control
+    return control.process_id() == 0
 
 
 def epoch_report(name: str, epoch: int, loss: float, watch: Stopwatch,

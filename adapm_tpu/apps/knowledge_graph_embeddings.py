@@ -33,8 +33,9 @@ from ..models.kge import make_eval_scores, make_kge_loss
 from ..ops import DeviceRoutedRunner, FusedStepRunner
 from ..utils import Stopwatch, alog
 from .common import (KeyMapper, RuntimeGuard, add_common_arguments,
-                     enforce_full_replication, epoch_report, make_server,
-                     wrap_batches, worker0_init)
+                     enforce_full_replication, epoch_report,
+                     global_worker_slices, make_server, wrap_batches,
+                     worker0_init)
 
 EVAL_LEN = 8  # [mrr_sum, h1, h10, count, ...pad] (reference eval_key len 20)
 
@@ -115,11 +116,16 @@ class KgeRun:
                 [rel, np.full_like(rel, a.adagrad_init)], axis=1)
         worker0_init(self.workers, self.ekey(np.arange(self.E)),
                      ent_rows.astype(np.float32))
+        from ..parallel import control
         w0 = self.workers[0]
         w0.begin_setup()
-        w0.set(self.rkey(np.arange(self.R)), rel_rows.astype(np.float32))
-        w0.set(np.array([self.loss_key_l]), np.zeros(1, np.float32))
-        w0.set(np.array([self.eval_key_l]), np.zeros(EVAL_LEN, np.float32))
+        if control.process_id() == 0:  # worker-0-of-process-0 initializes
+            w0.set(self.rkey(np.arange(self.R)),
+                   rel_rows.astype(np.float32))
+            w0.set(np.array([self.loss_key_l]), np.zeros(1, np.float32))
+            w0.set(np.array([self.eval_key_l]),
+                   np.zeros(EVAL_LEN, np.float32))
+            w0.wait_all()  # cross-process Sets land before the barrier
         w0.end_setup()
 
     def current_model(self):
@@ -138,15 +144,26 @@ class KgeRun:
     # -- PS-key aggregation (reference ps_allreduce, utils.h:163-197) --------
 
     def allreduce(self, key_l: int, contribution: np.ndarray) -> np.ndarray:
-        """Each worker pushes; after quiesce the main copy holds the sum."""
-        self.workers[0].push(np.array([key_l]),
-                             contribution.astype(np.float32))
+        """Each process's worker 0 pushes its contribution; after the
+        flush + barrier the key's main copy holds the global sum
+        (reference ps_allreduce: push -> barrier -> pull,
+        utils.h:163-197)."""
+        w0 = self.workers[0]
+        w0.wait(w0.push(np.array([key_l]),
+                        contribution.astype(np.float32)))
         self.srv.quiesce()
-        return self.srv.read_main(np.array([key_l]))
+        self.srv.barrier()
+        out = self.srv.read_main(np.array([key_l]))
+        self.srv.barrier()  # all reads done before anyone resets
+        return out
 
     def reset_key(self, key_l: int, length: int) -> None:
-        self.workers[0].set(np.array([key_l]),
-                            np.zeros(length, np.float32))
+        from ..parallel import control
+        if control.process_id() == 0:
+            w0 = self.workers[0]
+            w0.wait(w0.set(np.array([key_l]),
+                           np.zeros(length, np.float32)))
+        self.srv.barrier()
 
 
 def _flt_pairs(ab_pairs, flt: dict):
@@ -243,7 +260,8 @@ def run_app(args) -> dict:
         return dev_runners[shard]
 
     train = ds.train
-    parts = np.array_split(np.arange(len(train)), run.num_workers)
+    # data parallelism over ALL workers of ALL processes (kge.cc:968-970)
+    parts = global_worker_slices(len(train), run.num_workers)
     rng = np.random.default_rng(args.seed)
     guard = RuntimeGuard(args.max_runtime)
     watch = Stopwatch(start=True)
@@ -302,7 +320,13 @@ def run_app(args) -> dict:
 
         if args.eval_every and (epoch + 1) % args.eval_every == 0 and \
                 ds.valid is not None and len(ds.valid):
-            stats = evaluate(run, ds.valid[:args.eval_triples])
+            # eval work splits over processes; the PS-key allreduce below
+            # merges the partial stats (reference distributed Evaluator)
+            from ..parallel import control
+            ev = np.array_split(ds.valid[:args.eval_triples],
+                                control.num_processes()
+                                )[control.process_id()]
+            stats = evaluate(run, ev)
             agg = run.allreduce(run.eval_key_l, stats)
             run.reset_key(run.eval_key_l, EVAL_LEN)
             cnt = max(float(agg[3]), 1.0)
@@ -314,18 +338,25 @@ def run_app(args) -> dict:
                  f"Hits@10={result['hits10']:.4f}")
         if args.checkpoint_every and \
                 (epoch + 1) % args.checkpoint_every == 0:
-            os.makedirs(args.checkpoint_dir, exist_ok=True)
-            run.checkpoint(os.path.join(
-                args.checkpoint_dir, f"kge_epoch{epoch}.npz"))
+            from .common import is_rank0
+            if is_rank0():
+                os.makedirs(args.checkpoint_dir, exist_ok=True)
+                run.checkpoint(os.path.join(
+                    args.checkpoint_dir, f"kge_epoch{epoch}.npz"))
         if guard.expired():
             alog("[kge] max_runtime reached")
             break
 
     if ds.test is not None and len(ds.test) and args.eval_every:
-        stats = evaluate(run, ds.test[:args.eval_triples])
-        cnt = max(float(stats[3]), 1.0)
-        result.update(test_mrr=float(stats[0]) / cnt,
-                      test_hits10=float(stats[2]) / cnt)
+        from ..parallel import control
+        tv = np.array_split(ds.test[:args.eval_triples],
+                            control.num_processes())[control.process_id()]
+        stats = evaluate(run, tv)
+        agg = run.allreduce(run.eval_key_l, stats)
+        run.reset_key(run.eval_key_l, EVAL_LEN)
+        cnt = max(float(agg[3]), 1.0)
+        result.update(test_mrr=float(agg[0]) / cnt,
+                      test_hits10=float(agg[2]) / cnt)
         alog(f"[kge] TEST filtered MRR={result['test_mrr']:.4f} "
              f"Hits@10={result['test_hits10']:.4f}")
     alog("[kge]", srv.sync.report())

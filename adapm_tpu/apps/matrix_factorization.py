@@ -87,8 +87,14 @@ def run(args) -> float:
                 seed=args.seed + shard)
         return dev_runners[shard]
 
-    part = mfio.partition_points(rows, num_workers, m)
-    by_worker = [np.nonzero(part == w)[0] for w in range(num_workers)]
+    # row-block data partition over ALL workers of ALL processes
+    # (reference mf/io.h:125+; DSGD's block schedule spans them too)
+    from ..parallel import control
+    P, pid = control.num_processes(), control.process_id()
+    total_workers = P * num_workers
+    part = mfio.partition_points(rows, total_workers, m)
+    by_worker = [np.nonzero(part == pid * num_workers + wi)[0]
+                 for wi in range(num_workers)]
     B = args.batch_size
     lr = args.lr
     prev_loss = np.inf
@@ -113,18 +119,19 @@ def run(args) -> float:
 
     for epoch in range(args.epochs):
         if args.algorithm == "dsgd":
-            sched = mfio.dsgd_schedule(num_workers, epoch, seed=args.seed)
-            cblock = mfio.column_block(cols, num_workers, n)
-            for s in range(num_workers):
+            sched = mfio.dsgd_schedule(total_workers, epoch, seed=args.seed)
+            cblock = mfio.column_block(cols, total_workers, n)
+            for s in range(total_workers):
                 for wi, w in enumerate(workers):
+                    gwi = pid * num_workers + wi  # global worker id
                     mine = by_worker[wi]
-                    blk = mine[cblock[mine] == sched[s, wi]]
+                    blk = mine[cblock[mine] == sched[s, gwi]]
                     # intent for the *next* subepoch's block; the clock
                     # advances once per batch, so the window starts after
                     # this block's batches and spans the next block's
                     nb_cur = max(-(-len(blk) // B), 1)
-                    if s + 1 < num_workers:
-                        nxt = mine[cblock[mine] == sched[s + 1, wi]]
+                    if s + 1 < total_workers:
+                        nxt = mine[cblock[mine] == sched[s + 1, gwi]]
                         if len(nxt):
                             nb_nxt = max(-(-len(nxt) // B), 1)
                             signal_intent(w, nxt, w.current_clock + nb_cur,
@@ -172,7 +179,7 @@ def run(args) -> float:
             alog("[mf] max_runtime reached")
             break
 
-    if args.export_prefix:
+    if args.export_prefix and pid == 0:
         Wc, Hc = _current_factors(srv, kmap, m, n, rank)
         mfio.write_dense(args.export_prefix + "W.mma", Wc)
         mfio.write_dense(args.export_prefix + "H.mma", Hc)

@@ -28,8 +28,8 @@ from ..models.sgns import (build_alias_table, build_unigram_table,
 from ..ops import DeviceRoutedRunner, FusedStepRunner
 from ..utils import Stopwatch, alog
 from .common import (KeyMapper, RuntimeGuard, add_common_arguments,
-                     enforce_full_replication, epoch_report, make_server,
-                     worker0_init)
+                     enforce_full_replication, epoch_report,
+                     global_worker_slices, make_server, worker0_init)
 
 
 def _pairs_for(sent: np.ndarray, sent_idx: int, window: int, seed: int,
@@ -110,13 +110,14 @@ def run(args) -> float:
     watch = Stopwatch(start=True)
     mean_loss = 0.0
 
-    # per-worker contiguous sentence partition (reference :524-531)
-    bounds = np.linspace(0, len(sents), num_workers + 1).astype(int)
+    # per-worker contiguous sentence partition over all processes'
+    # workers (reference :524-531)
+    slices = global_worker_slices(len(sents), num_workers)
 
     for epoch in range(args.epochs):
         losses = []
         for wi, w in enumerate(workers):
-            my = list(range(bounds[wi], bounds[wi + 1]))
+            my = slices[wi].tolist()
             # (sent position, sample handle) for prepared future sentences
             prepared: deque = deque()
             buf_c: List[np.ndarray] = []
@@ -189,8 +190,10 @@ def run(args) -> float:
         srv.quiesce()
         mean_loss = float(np.mean([float(l) for l in losses])) \
             if losses else 0.0
+        from ..parallel import control
+        mean_loss = float(control.allreduce(mean_loss, "mean")[0])
         epoch_report("w2v", epoch, mean_loss, watch)
-        if args.export_prefix:
+        if args.export_prefix and control.process_id() == 0:
             _export(srv, kmap, words, d,
                     f"{args.export_prefix}epoch{epoch}.txt")
         if guard.expired():

@@ -255,30 +255,44 @@ class Addressbook:
         self.relocation_counter[key] += 1
         return old_shard, old_slot, new_slot
 
-    def adopt_batch(self, keys: np.ndarray, shard: int) -> np.ndarray:
+    def adopt_batch(self, keys: np.ndarray, shard: int):
         """Cross-process relocation, requester side: this process takes
-        ownership of `keys` (currently REMOTE, single class), placing their
-        main copies on local `shard`. Returns the allocated slots. Raises
-        if the main pool lacks capacity — pools are sized to hold every key
-        of the class (ShardedStore geometry), so exhaustion is a bug, not a
-        load condition (contrast relocate_batch's graceful truncation)."""
+        ownership of `keys` (currently REMOTE, single class), preferring
+        local `shard` and SPILLING OVER to sibling shards when its pool
+        is full (reads reach sibling shards through the cross-shard
+        gather, so spillover trades some intra-process locality, never
+        correctness). Returns (shards, slots). Raises only if the whole
+        process is out of pool — impossible by construction: per-shard
+        pools are over-allocated so their sum exceeds the class size."""
         if len(keys) == 0:
-            return np.empty(0, dtype=np.int64)
+            e = np.empty(0, dtype=np.int64)
+            return e, e
         assert (self.owner[keys] == REMOTE).all(), \
             "adopt_batch keys must be remotely owned"
         cls = self.key_class[keys]
         assert (cls == cls[0]).all(), "adopt_batch must be single-class"
         alloc = self.main_alloc[int(cls[0])]
-        slots = alloc.alloc_batch(shard, len(keys))
-        if len(slots) < len(keys):
+        sh_out = np.empty(len(keys), dtype=np.int64)
+        sl_out = np.empty(len(keys), dtype=np.int64)
+        order = [shard] + sorted(
+            (s for s in range(self.num_shards) if s != shard),
+            key=alloc.num_free, reverse=True)
+        pos = 0
+        for s in order:
+            if pos >= len(keys):
+                break
+            slots = alloc.alloc_batch(s, len(keys) - pos)
+            sh_out[pos:pos + len(slots)] = s
+            sl_out[pos:pos + len(slots)] = slots
+            pos += len(slots)
+        if pos < len(keys):
             raise RuntimeError(
-                f"shard {shard} out of main pool slots while adopting "
-                f"{len(keys)} relocated keys (pool "
-                f"{alloc.slots_per_shard}); increase over_alloc")
-        self.owner[keys] = shard
-        self.slot[keys] = slots
+                f"process out of main pool slots while adopting "
+                f"{len(keys) - pos} relocated keys; increase over_alloc")
+        self.owner[keys] = sh_out
+        self.slot[keys] = sl_out
         self.relocation_counter[keys] += 1
-        return slots
+        return sh_out, sl_out
 
     def abandon_batch(self, keys: np.ndarray) -> None:
         """Cross-process relocation, owner side: release ownership of

@@ -94,9 +94,19 @@ class Server:
 
         self.stores: List[ShardedStore] = []
         for cid, L in enumerate(self.class_lengths):
+            cache_slots = self.opts.cache_slots_per_shard
+            if cache_slots == 0 and self.num_procs > 1:
+                # multi-process auto default: data-parallel workloads
+                # contest keys across processes, so give each shard 2x the
+                # per-shard fair share (bounded by the class size). At
+                # memory-bound scale tune --sys.cache_slots explicitly —
+                # ensure_local raises with that hint when the pool is the
+                # limit; expired replicas are dropped to make room first.
+                fair = -(-int(class_counts[cid]) // self.ctx.num_shards)
+                cache_slots = min(2 * fair, int(class_counts[cid]))
             self.stores.append(ShardedStore(
                 int(class_counts[cid]), L, self.ctx, dtype=self.dtype,
-                cache_slots_per_shard=self.opts.cache_slots_per_shard,
+                cache_slots_per_shard=cache_slots,
                 bucket_min=self.opts.remote_bucket_min))
         self.ab = Addressbook(
             key_class, self.ctx.num_shards,
@@ -431,6 +441,43 @@ class Server:
                 self.stores[cid].set_rows(o_sh, o_sl, rows, zeros, oob)
             else:
                 self.stores[cid].scatter_add(o_sh, o_sl, zeros, oob, rows)
+
+    def ensure_local(self, keys: np.ndarray, shard: int) -> None:
+        """Make process-remote `keys` locally servable (replicate or adopt
+        via the owner's decision) — the fused runners' miss path: apps
+        normally signal intent ahead so keys are local by step time; a
+        cold miss blocks here once instead of computing on garbage rows.
+        No-op in a single process."""
+        if self.glob is None:
+            return
+        with self._lock:
+            rem = keys[(self.ab.owner[keys] < 0)
+                       & (self.ab.cache_slot[shard, keys] < 0)]
+        if len(rem) == 0:
+            return
+        import time as _time
+        rem = np.unique(rem)
+        end = int(self._clocks.max()) + 2
+        self.sync.intent_end[shard, rem] = np.maximum(
+            self.sync.intent_end[shard, rem], end)
+        for attempt in range(50):
+            self.glob.intent_remote(rem, shard, end)
+            # installs are deferred for keys with in-flight remote writes
+            # (and capacity-truncated ones get unsubscribed) — retry until
+            # everything is servable locally
+            with self._lock:
+                rem = rem[(self.ab.owner[rem] < 0)
+                          & (self.ab.cache_slot[shard, rem] < 0)]
+            if len(rem) == 0:
+                return
+            # a full cache pool frees up as expired replicas drop: drive a
+            # full sync round (flush + drop) before retrying
+            with self._round_lock:
+                self.sync.run_round(all_channels=True)
+            _time.sleep(0.005 * (attempt + 1))
+        raise RuntimeError(
+            f"{len(rem)} keys could not be made local on shard {shard} "
+            f"(cache pool full?); first: {rem[:5].tolist()}")
 
     def _prune_rw_pending(self) -> None:
         """Drop completed remote-write records (caller holds the lock). A

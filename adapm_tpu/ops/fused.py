@@ -93,6 +93,9 @@ def build_routes(server, keys: np.ndarray, shard: int,
         assert (kc == expect_class).all(), (
             f"keys span length classes {np.unique(kc)} but role is mapped "
             f"to class {expect_class}")
+    # multi-process: a key owned by another process cannot be gathered by
+    # the local program — make it local first (miss = fetch)
+    server.ensure_local(keys, shard)
     o_sh, o_sl, c_sh, c_sl, use_c, n_remote = server._route(keys, shard)
     g_sl = np.where(use_c, OOB, o_sl).astype(np.int32)
     return Routes(jnp.asarray(o_sh), jnp.asarray(g_sl), jnp.asarray(c_sh),
@@ -436,6 +439,9 @@ class DeviceRoutedRunner:
             assert (kc == self.role_class[r]).all(), (
                 f"role {r}: keys span length classes {np.unique(kc)} but "
                 f"role is mapped to class {self.role_class[r]}")
+            # multi-process: device tables carry owner=-1 for keys owned by
+            # another process — fetch them before routing on device
+            srv.ensure_local(k64, self.shard)
         with srv._lock:
             tables = self.router.tables()
             local_index = self._local_neg_index() \

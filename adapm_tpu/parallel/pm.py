@@ -528,10 +528,10 @@ class GlobalPM:
                     for k, c in zip(dropped.tolist(), chans.tolist()):
                         srv.sync.replicas[c].discard((int(k), s))
                     ab.drop_replicas(dropped, s)
-                slots = ab.adopt_batch(ks, shard)
+                shards, slots = ab.adopt_batch(ks, shard)
                 nk = len(ks)
                 srv.stores[cid].set_rows(
-                    np.full(nk, shard, np.int32), slots.astype(np.int32),
+                    shards.astype(np.int32), slots.astype(np.int32),
                     rows, np.zeros(nk, np.int32), np.full(nk, OOB, np.int32))
             srv.topology_version += 1
             self.stats["relocations_in"] += len(keys)

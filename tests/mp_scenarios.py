@@ -307,6 +307,25 @@ def scenario_ckpt_restore():
     print(f"MP-OK ckpt_restore rank={rank}")
 
 
+def scenario_kge_app():
+    """Full KGE app, data-parallel across processes: global worker data
+    partition, cross-process parameter traffic via intent/ensure_local,
+    PS-key loss/eval allreduce, distributed eval. The whole stack,
+    end to end (reference: the same binary runs on every node)."""
+    from adapm_tpu.apps import knowledge_graph_embeddings as kge
+    args = kge.build_parser().parse_args(
+        ["--dim", "8", "--neg_ratio", "2", "--synthetic_entities", "60",
+         "--synthetic_relations", "4", "--synthetic_triples", "400",
+         "--epochs", "6", "--batch_size", "32", "--lr", "0.2",
+         "--eval_every", "6", "--eval_triples", "60",
+         "--sys.sync.max_per_sec", "0"])
+    result = kge.run_app(args)
+    rank = control.process_id()
+    assert np.isfinite(result["loss"]), result
+    assert result["mrr"] > 0.12, f"rank {rank}: no learning: {result}"
+    print(f"MP-OK kge_app rank={rank}")
+
+
 def scenario_heartbeat():
     """Heartbeat + dead-node detection (reference van heartbeats +
     Postoffice::GetDeadNodes): rank 1 stops beating; rank 0 must report it
@@ -343,6 +362,7 @@ SCENARIOS = {
     "ckpt_save": scenario_ckpt_save,
     "ckpt_restore": scenario_ckpt_restore,
     "heartbeat": scenario_heartbeat,
+    "kge_app": scenario_kge_app,
 }
 
 if __name__ == "__main__":

@@ -93,6 +93,13 @@ def test_mp_checkpoint_crash_recovery(tmp_path):
 
 
 @pytest.mark.slow
+def test_mp_kge_app_data_parallel():
+    """The full KGE app trains data-parallel across 2 processes and
+    reaches the same quality bar as the single-process run."""
+    run_mp(2, "kge_app", timeout=600)
+
+
+@pytest.mark.slow
 def test_mp_heartbeat_dead_node_detection():
     """--sys.heartbeat: a rank that stops beating is reported by
     dead_nodes() (reference GetDeadNodes, src/postoffice.cc:202-221)."""

@@ -30,4 +30,15 @@ python -m adapm_tpu.apps.knowledge_graph_embeddings --dim 8 \
   --synthetic_triples 400 --epochs 2 --batch_size 32 --eval_every 2 \
   --eval_triples 40 $FAST
 
+echo "=== knowledge_graph_embeddings, 2 launched processes ==="
+# the reference smoke-runs every app under `dmlc_local.py -s 2`
+# (tests/run_apps.sh); same shape here via the launcher
+JAX_PLATFORMS=cpu ADAPM_PLATFORM=cpu \
+XLA_FLAGS="--xla_force_host_platform_device_count=2" \
+python -m adapm_tpu.launcher -n 2 --no-keepalive -- \
+  python -m adapm_tpu.apps.knowledge_graph_embeddings --dim 8 \
+  --neg_ratio 2 --synthetic_entities 60 --synthetic_relations 4 \
+  --synthetic_triples 400 --epochs 2 --batch_size 32 --eval_every 2 \
+  --eval_triples 40 $FAST
+
 echo "ALL APPS PASSED"

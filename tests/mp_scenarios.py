@@ -161,8 +161,14 @@ def scenario_monotonic():
 def scenario_eventual():
     """Eventual consistency: every rank pushes then reverts on a shared key
     set under replication; after the quiesce protocol all ranks read the
-    exact base everywhere (reference test_many_key_operations phase 3)."""
-    srv = adapm_tpu.setup(48, 4, opts=SystemOptions(sync_max_per_sec=0))
+    exact base everywhere (reference test_many_key_operations phase 3).
+    argv[2] selects --sys.techniques (the reference's run_tests.sh
+    variants: all / replication_only / relocation_only)."""
+    from adapm_tpu.base import MgmtTechniques
+    tech = MgmtTechniques(sys.argv[2]) if len(sys.argv) > 2 \
+        else MgmtTechniques.ALL
+    srv = adapm_tpu.setup(48, 4, opts=SystemOptions(
+        sync_max_per_sec=0, techniques=tech))
     rank = control.process_id()
     w = srv.make_worker(0)
     keys = np.arange(48, dtype=np.int64)

@@ -67,10 +67,13 @@ def test_mp_monotonic_contended_pushes():
 
 
 @pytest.mark.slow
-def test_mp_eventual_consistency():
+@pytest.mark.parametrize("tech", ["all", "replication_only",
+                                  "relocation_only"])
+def test_mp_eventual_consistency(tech):
     """Push+revert restores the exact base on every rank after
-    WaitSync -> Barrier -> WaitSync (2 procs)."""
-    run_mp(2, "eventual")
+    WaitSync -> Barrier -> WaitSync (2 procs), under every management
+    technique (reference run_tests.sh --sys.techniques variants)."""
+    run_mp(2, "eventual", args=(tech,))
 
 
 @pytest.mark.slow

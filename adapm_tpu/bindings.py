@@ -48,7 +48,11 @@ def _as_numpy(x) -> np.ndarray:
 def setup(num_keys: int, num_threads: int, use_techniques: str = "",
           num_channels: int = -1) -> None:
     """Record global PM options (reference bindings.cc setup: techniques and
-    channel count are process-wide, applied to Servers constructed later)."""
+    channel count are process-wide, applied to Servers constructed later).
+    Under the launcher this also joins the multi-process runtime — the
+    reference's ps::Setup -> Postoffice::Start."""
+    from .parallel import control
+    control.init_from_env()
     global _global_opts
     from .base import MgmtTechniques
     opts = SystemOptions()

@@ -332,6 +332,33 @@ def scenario_kge_app():
     print(f"MP-OK kge_app rank={rank}")
 
 
+def scenario_bindings():
+    """The torch/numpy bindings surface works across launched processes
+    (the reference's bindings example runs 4 simulated nodes —
+    bindings/example.py): cross-process push/pull through the bindings
+    Worker, intent-driven locality, exact sums after barrier."""
+    import adapm_tpu.bindings as adapm
+    adapm.setup(num_keys=32, num_threads=1)  # joins jax.distributed FIRST
+    rank = control.process_id()
+    P = control.num_processes()
+    srv = adapm.Server(4, 32)
+    w = adapm.Worker(0, srv)
+    keys = np.arange(32, dtype=np.int64)
+    vals = np.ones((32, 4), np.float32)
+    ts = w.push(keys, vals, asynchronous=True)
+    w.wait(ts)
+    srv.barrier()
+    out = np.zeros((32, 4), np.float32)
+    w.pull(keys, out)
+    assert np.allclose(out, P), out[:2]
+    w.intent(keys[:4], w.current_clock, w.current_clock + 10)
+    w.wait_sync()
+    srv.barrier()
+    w.finalize()
+    srv.shutdown()
+    print(f"MP-OK bindings rank={rank}")
+
+
 def scenario_heartbeat():
     """Heartbeat + dead-node detection (reference van heartbeats +
     Postoffice::GetDeadNodes): rank 1 stops beating; rank 0 must report it
@@ -369,6 +396,7 @@ SCENARIOS = {
     "ckpt_restore": scenario_ckpt_restore,
     "heartbeat": scenario_heartbeat,
     "kge_app": scenario_kge_app,
+    "bindings": scenario_bindings,
 }
 
 if __name__ == "__main__":

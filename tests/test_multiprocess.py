@@ -96,6 +96,13 @@ def test_mp_checkpoint_crash_recovery(tmp_path):
 
 
 @pytest.mark.slow
+def test_mp_bindings():
+    """The bindings surface (reference bindings/example.py's multi-node
+    shape) works across 2 launched processes."""
+    run_mp(2, "bindings")
+
+
+@pytest.mark.slow
 def test_mp_kge_app_data_parallel():
     """The full KGE app trains data-parallel across 2 processes and
     reaches the same quality bar as the single-process run."""

@@ -536,6 +536,9 @@ class GlobalPM:
             srv.topology_version += 1
             self.stats["relocations_in"] += len(keys)
             srv.sync.stats.relocations += len(keys)
+            if srv.tracer is not None:
+                from ..utils.stats import RELOCATE
+                srv.tracer.record(keys, RELOCATE, shard)
 
     def _install_replicas(self, keys: np.ndarray, flat: np.ndarray,
                           shard: int) -> None:
@@ -583,6 +586,9 @@ class GlobalPM:
                     for k, c in zip(took.tolist(), chans.tolist()):
                         srv.sync.replicas[c].add((int(k), shard))
                     srv.sync.stats.replicas_created += len(took)
+                    if srv.tracer is not None:
+                        from ..utils.stats import REPLICA_SETUP
+                        srv.tracer.record(took, REPLICA_SETUP, shard)
                 if len(cs) < len(ks):  # cache pool full
                     surplus.append(ks[len(cs):])
             srv.topology_version += 1
@@ -736,6 +742,10 @@ class GlobalPM:
                 for s in np.unique(sarr[pos]):
                     m = sarr[pos] == s
                     ab.drop_replicas(karr[pos][m], int(s))
+                    if srv.tracer is not None:
+                        from ..utils.stats import REPLICA_DROP
+                        srv.tracer.record(karr[pos][m], REPLICA_DROP,
+                                          int(s))
             for k, s in items:
                 c = int(key_channel(np.asarray([k]),
                                     srv.sync.num_channels)[0])

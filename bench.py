@@ -38,7 +38,18 @@ if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
 
+import sys
+
 import numpy as np
+
+
+def _progress(msg: str) -> None:
+    """Phase progress on stderr (stdout carries only the JSON line)."""
+    print(f"[bench +{time.perf_counter() - _T0:7.1f}s] {msg}",
+          file=sys.stderr, flush=True)
+
+
+_T0 = time.perf_counter()
 
 
 def _skewed_keys(rng, n, size):
@@ -56,6 +67,7 @@ def bench_tpu(E=200_000, R=1_000, d=128, B=4096, N=32, steps=50,
     from adapm_tpu.ops import DeviceRoutedRunner
 
     num_keys = E + R
+    _progress(f"kge phase: building server ({num_keys} keys)")
     srv = adapm_tpu.setup(num_keys, 4 * d,
                           opts=SystemOptions(cache_slots_per_shard=1,
                                              sync_max_per_sec=0))
@@ -69,6 +81,7 @@ def bench_tpu(E=200_000, R=1_000, d=128, B=4096, N=32, steps=50,
         vals[:, 2 * d:] = 1e-6
         w.set(np.arange(lo, hi), vals)
     srv.block()
+    _progress("kge phase: init done, compiling + warmup")
 
     # device-routed runner: routing tables mirrored in HBM, negatives drawn
     # in-program (Local sampling scheme on device) — the host ships only the
@@ -124,9 +137,11 @@ def bench_tpu(E=200_000, R=1_000, d=128, B=4096, N=32, steps=50,
     for _ in range(warmup):
         pm_step(0)
     timed(1)
+    _progress("kge phase: timing")
     t_short = timed(steps // 4)
     t_long = timed(steps)
     dt = (t_long - t_short) / (steps - steps // 4)
+    _progress(f"kge phase: {B / dt:.0f} triples/s ({dt * 1e3:.1f} ms/step)")
     return B / dt, srv
 
 
@@ -305,11 +320,15 @@ def main():
     srv.shutdown()
     # dedup lever (docs/PERF.md): all-unique batches bound what a perfect
     # in-step dedup could gain over the skewed batches
+    _progress("dedup phase")
     tput_unique, srv2 = bench_tpu(steps=24, dedup_batches=True)
     srv2.shutdown()
+    _progress("adaptive-pm phase (8 virtual CPU shards)")
     pm = bench_adaptive_pm()
     pm.update(kernel_stats)
+    _progress("w2v phase")
     w2v = bench_w2v()
+    _progress("cpu-baseline phase")
     # measured per-core CPU throughput of a strong batched torch
     # implementation of the same step; the paper's 8-node x 8-thread
     # cluster is modeled as 64 such cores (conservative: AdaPM's

@@ -184,14 +184,9 @@ def broadcast(values, root: int = 0) -> np.ndarray:
         arr, is_source=jax.process_index() == root))
 
 
-def intent_summary_allgather(local_summary: np.ndarray) -> np.ndarray:
-    """Exchange per-host intent summaries so every host's planner sees the
-    global interest picture (the multi-host analog of the reference's
-    per-sender node_intent sets, sync_manager.h:182, 571, 644).
-    local_summary is any fixed-shape numeric array; returns [P, ...]."""
-    import jax
-    arr = np.atleast_1d(np.asarray(local_summary))
-    if jax.process_count() == 1:
-        return arr[None]
-    from jax.experimental import multihost_utils
-    return np.asarray(multihost_utils.process_allgather(arr))
+# NOTE: an earlier draft exposed intent_summary_allgather here for a
+# planner-side global interest exchange. The implemented design keeps the
+# reference's shape instead: interest is tracked OWNER-side as per-key
+# process bitmasks updated by intent/unsub traffic (parallel/pm.py
+# GlobalPM.interest — the node_intent sets of sync_manager.h:182, 571,
+# 644), so no allgather is needed on the decision path.

@@ -48,9 +48,11 @@ def run_mp(n, scenario, devices=2, args=(), timeout=300):
 
 
 @pytest.mark.slow
-def test_mp_pull_push_set():
-    """Cross-process Pull/Push/Set land exactly (2 procs x 2 devices)."""
-    run_mp(2, "pullpush")
+@pytest.mark.parametrize("n,devices", [(2, 2), (4, 1)])
+def test_mp_pull_push_set(n, devices):
+    """Cross-process Pull/Push/Set land exactly (2 procs x 2 shards and
+    4 procs x 1 shard — the reference tests run 3-4 nodes)."""
+    run_mp(n, "pullpush", devices=devices)
 
 
 @pytest.mark.slow

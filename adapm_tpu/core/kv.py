@@ -131,6 +131,12 @@ class Server:
         self._sync_thread: Optional[threading.Thread] = None
         self._sync_stop = threading.Event()
 
+        # debug: per-key additive-apply counter (ADAPM_DEBUG_APPLIES=1);
+        # diagnostics only — see tests/mp_bisect.py
+        import os as _os
+        self._dbg_applies = np.zeros(self.num_keys) \
+            if _os.environ.get("ADAPM_DEBUG_APPLIES") else None
+
         # cross-process layer: N launched processes form one PM
         # (parallel/pm.py; reference van/postoffice data plane)
         self.glob = None
@@ -392,6 +398,8 @@ class Server:
                 self.stores[cid].set_rows(o_sh, o_sl, rows, c_sh, c_sl)
             else:
                 n_remote += nr
+                if self._dbg_applies is not None:
+                    np.add.at(self._dbg_applies, ks, rows[:, 0])
                 o_sl = np.where(use_c, OOB, o_sl).astype(np.int32)
                 self.stores[cid].scatter_add(o_sh, o_sl, c_sh, c_sl, rows)
         return n_remote, futures
@@ -440,6 +448,8 @@ class Server:
             if is_set:
                 self.stores[cid].set_rows(o_sh, o_sl, rows, zeros, oob)
             else:
+                if self._dbg_applies is not None:
+                    np.add.at(self._dbg_applies, ks, rows[:, 0])
                 self.stores[cid].scatter_add(o_sh, o_sl, zeros, oob, rows)
 
     def ensure_local(self, keys: np.ndarray, shard: int) -> None:
@@ -1010,13 +1020,20 @@ class Worker:
 
     def set(self, keys, vals) -> int:
         """Overwrite values (reference Set: non-additive write)."""
+        import contextlib
         keys = self._keys(keys)
         vals = np.asarray(vals, dtype=np.float32)
         srv = self.server
         after = self._live_write_futs() if srv.glob is not None else ()
-        with srv._lock:
-            n_remote, futs = srv._push(keys, vals, self.shard,
-                                       is_set=True, after=after)
+        # Set may invalidate (consume the delta of) cross-process replicas;
+        # that must not interleave with an in-flight sync round's extracted
+        # delta (pm.py _delta_mutex; taken BEFORE the server lock)
+        dm = srv.glob._delta_mutex if srv.glob is not None \
+            else contextlib.nullcontext()
+        with dm:
+            with srv._lock:
+                n_remote, futs = srv._push(keys, vals, self.shard,
+                                           is_set=True, after=after)
         self._write_futs.extend(futs)
         if n_remote == 0:
             return LOCAL

@@ -134,10 +134,25 @@ class GlobalPM:
         # at the owner: bit p set = process p holds a replica of the key
         self.interest = np.zeros(K, dtype=np.uint64)
 
+        import os as _os
+        self._dbg = None
+        if _os.environ.get("ADAPM_DEBUG_APPLIES"):
+            self._dbg = {"sent": np.zeros(K), "served": np.zeros(K)}
+
         self.stats = {"pulls_in": 0, "pushes_in": 0, "redirects": 0,
                       "intents_in": 0, "relocations_out": 0,
                       "relocations_in": 0, "replicas_granted": 0,
                       "syncs_in": 0, "keys_synced_out": 0}
+
+        # Serializes "delta in flight" windows: a cross-process sync round
+        # holds this across extract -> ship -> refresh; anything that
+        # CONSUMES a replica's pending delta (adoption's replica->owner
+        # upgrade, Set's replica invalidation) must take it first —
+        # otherwise the consumed delta double-applies when the in-flight
+        # round lands at the (possibly now-local) owner. Lock order:
+        # _delta_mutex BEFORE server._lock; handler threads never take it.
+        import threading
+        self._delta_mutex = threading.Lock()
 
         self.chan = DcnChannel(self.pid, self.num_procs, self._handle)
         self.chan.start()
@@ -208,8 +223,14 @@ class GlobalPM:
                 self.stats["redirects"] += len(pending)
                 time.sleep(min(0.002 * tries, 0.1))
             still: List[np.ndarray] = []
-            for d in np.unique(dest[pending]):
-                pos = pending[dest[pending] == d]
+            # freeze this round's grouping: redirect handling below mutates
+            # `dest`, and re-evaluating dest[pending] mid-loop would let a
+            # key redirected out of an earlier group be served by a later
+            # group in the SAME round and then retried next round — a
+            # double apply (caught by tests/mp_bisect.py reloc_only)
+            dcur = dest[pending].copy()
+            for d in np.unique(dcur):
+                pos = pending[dcur == d]
                 msg = make_msg(keys[pos], pos)
                 reply = serve_local(msg) if d == self.pid \
                     else self.chan.request(int(d), msg)
@@ -325,9 +346,12 @@ class GlobalPM:
             owned = srv.ab.owner[keys] >= 0
             pos = np.nonzero(owned)[0]
             if len(pos):
-                srv._apply_remote_write(
-                    keys[pos], _select_flat(flat, offs, lens, pos), is_set)
+                part = _select_flat(flat, offs, lens, pos)
+                srv._apply_remote_write(keys[pos], part, is_set)
                 owners[pos] = self.pid
+                if self._dbg is not None and not is_set:
+                    np.add.at(self._dbg["served"], keys[pos],
+                              part[_offsets(lens[pos])[:-1]])
         rem = np.nonzero(~owned)[0]
         if len(rem):
             owners[rem] = self._hint_for(keys[rem])
@@ -338,6 +362,8 @@ class GlobalPM:
         lens = self.server.value_lengths[keys]
         offs = _offsets(lens)
         op = "set" if is_set else "push"
+        if self._dbg is not None and not is_set:
+            np.add.at(self._dbg["sent"], keys, flat[offs[:-1]])
 
         def make(ks, pos):
             return (op, ks, _select_flat(flat, offs, lens, pos))
@@ -456,7 +482,12 @@ class GlobalPM:
     def intent_remote(self, keys: np.ndarray, shard: int, end: int) -> None:
         """Requester side: act on an intent for remotely-owned keys — ask
         each owner to relocate or replicate, then install the outcome
-        locally. Called from the planner (SyncManager._register)."""
+        locally. Called from the planner (SyncManager._register) and the
+        miss path (Server.ensure_local)."""
+        with self._delta_mutex:  # adoption consumes replica deltas
+            self._intent_remote_locked(keys, shard, end)
+
+    def _intent_remote_locked(self, keys, shard, end) -> None:
         srv = self.server
         # writes completed before this point are applied at their owners,
         # so the owner's base snapshot during this RPC will include them;
@@ -656,6 +687,10 @@ class GlobalPM:
         extract pending deltas, ship to owners, install fresh bases.
         Requester side of the reference's startSync/response branch
         (sync_manager.h:291-382, 740-799)."""
+        with self._delta_mutex:
+            self._sync_replicas_locked(items)
+
+    def _sync_replicas_locked(self, items: List[Tuple[int, int]]) -> None:
         srv = self.server
         karr = np.fromiter((k for k, _ in items), np.int64, len(items))
         sarr = np.fromiter((s for _, s in items), np.int32, len(items))
@@ -699,6 +734,10 @@ class GlobalPM:
         with the unsubscription, then free the slots. Any pushes that land
         between extraction and the free are re-shipped as plain remote
         pushes, so no update is ever lost."""
+        with self._delta_mutex:
+            self._drop_replicas_locked(items)
+
+    def _drop_replicas_locked(self, items: List[Tuple[int, int]]) -> None:
         srv = self.server
         from ..core.sync import key_channel
         karr = np.fromiter((k for k, _ in items), np.int64, len(items))

@@ -332,6 +332,63 @@ def scenario_kge_app():
     print(f"MP-OK kge_app rank={rank}")
 
 
+def scenario_stress():
+    """True-concurrency cross-process stress: 2 worker THREADS per process
+    push into overlapping skewed key sets under intent churn with the
+    background sync thread running; after the quiesce protocol every key's
+    main copy equals the exact global push count (reference
+    test_dynamic_allocation's contended exactness, scaled to threads x
+    processes)."""
+    import threading
+    K = 48
+    srv = adapm_tpu.setup(K, 2, opts=SystemOptions(sync_max_per_sec=300))
+    srv.start_sync_thread()
+    rank = control.process_id()
+    ws = [srv.make_worker(i) for i in range(2)]
+    counts = np.zeros(K, dtype=np.float64)
+    counts_lock = threading.Lock()
+    errs = []
+
+    def work(wi):
+        w = ws[wi]
+        rng = np.random.default_rng(1000 * rank + wi)
+        try:
+            for i in range(25):
+                keys = np.unique((K * rng.random(6) ** 2).astype(np.int64))
+                if rng.random() < 0.5:
+                    w.intent(keys, w.current_clock, w.current_clock + 3)
+                ts = w.push(keys, np.ones((len(keys), 2), np.float32))
+                w.wait(ts)
+                with counts_lock:
+                    counts[keys] += 1
+                if rng.random() < 0.3:
+                    v = w.pull_sync(keys)
+                    assert np.isfinite(v).all()
+                w.advance_clock()
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    threads = [threading.Thread(target=work, args=(wi,)) for wi in (0, 1)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs, errs
+    for w in ws:
+        w.wait_all()
+    srv.wait_sync()
+    srv.barrier()
+    srv.wait_sync()
+    srv.barrier()
+    total = control.allreduce(counts, "sum")
+    final = srv.read_main(np.arange(K)).reshape(K, 2)
+    assert np.allclose(final, total[:, None], atol=1e-3), \
+        f"rank {rank}: lost/duplicated updates\n{final[:, 0] - total}"
+    srv.barrier()
+    srv.shutdown()
+    print(f"MP-OK stress rank={rank}")
+
+
 def scenario_bindings():
     """The torch/numpy bindings surface works across launched processes
     (the reference's bindings example runs 4 simulated nodes —
@@ -397,6 +454,7 @@ SCENARIOS = {
     "heartbeat": scenario_heartbeat,
     "kge_app": scenario_kge_app,
     "bindings": scenario_bindings,
+    "stress": scenario_stress,
 }
 
 if __name__ == "__main__":

@@ -98,6 +98,14 @@ def test_mp_checkpoint_crash_recovery(tmp_path):
 
 
 @pytest.mark.slow
+def test_mp_thread_process_stress():
+    """2 worker threads x 2 processes hammer overlapping keys under intent
+    churn + background sync; final main copies equal the exact global
+    push counts."""
+    run_mp(2, "stress", timeout=420)
+
+
+@pytest.mark.slow
 def test_mp_bindings():
     """The bindings surface (reference bindings/example.py's multi-node
     shape) works across 2 launched processes."""

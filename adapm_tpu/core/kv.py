@@ -115,6 +115,11 @@ class Server:
             num_procs=self.num_procs, pid=self.pid)
 
         self.num_shards = self.ctx.num_shards
+        # explicit num_workers DECLARES the worker set (reference
+        # Setup(num_keys, num_threads)): worker barriers then rendezvous
+        # over all declared ids, so an early barrier cannot slip past
+        # workers whose threads have not registered yet
+        self._wb_declared = num_workers is not None
         self.max_workers = num_workers or max(self.num_shards, 1)
         self._workers: Dict[int, "Worker"] = {}
         self._clocks = np.zeros(self.max_workers, dtype=np.int64)
@@ -123,6 +128,16 @@ class Server:
         # round-trips — see parallel/pm.py locking discipline
         self._round_lock = threading.Lock()
         self._in_setup = False
+        # worker-thread barrier state (reference ColoKVWorker::Barrier is a
+        # barrier over ALL workers, threads included, via the scheduler's
+        # BARRIER counting — src/postoffice.cc:149-174): generation counter
+        # + the set of arrived worker ids; see worker_barrier()
+        self._wb_cond = threading.Condition()
+        self._wb_waiting: set = set()
+        self._wb_gen = 0        # generation currently accepting arrivals
+        self._wb_done = 0       # generations fully completed
+        self._wb_leading = False
+        self._wb_errs: Dict[int, BaseException] = {}  # gen -> leader error
         # bumped whenever placement changes (replica add/drop, relocation);
         # consumers (LocalSampling) use it to invalidate local-key caches
         self.topology_version = 0
@@ -740,6 +755,70 @@ class Server:
         self._sync_thread.join()
         self._sync_thread = None
 
+    def _wb_active_ids(self) -> set:
+        """Worker ids that participate in worker barriers: the declared set
+        when the Server was built with an explicit num_workers (reference
+        Setup(num_keys, num_threads) declares the thread count), else the
+        workers registered so far; finalized workers (clock ==
+        WORKER_FINISHED) are excluded either way."""
+        ids = range(self.max_workers) if self._wb_declared \
+            else list(self._workers)  # copy: registration mutates the dict
+        return {wid for wid in ids
+                if self._clocks[wid] != WORKER_FINISHED}
+
+    def worker_barrier(self, worker_id: int) -> None:
+        """Barrier across ALL active worker threads of all processes
+        (reference ColoKVWorker::Barrier -> Postoffice::Barrier over the
+        worker group): local threads rendezvous first, then one leader per
+        process runs the cross-process barrier. A worker that finalizes
+        while others wait is excluded (finalize() re-notifies).
+
+        Cross-process contract (same as control.barrier): every process
+        must run the same sequence of barrier generations — finalize
+        exclusion is process-local, so an app whose ranks retire ALL their
+        workers at different times while other ranks still barrier is
+        misusing the API (it would equally hang the reference's
+        scheduler-counted barriers)."""
+        with self._wb_cond:
+            gen = self._wb_gen  # the generation this arrival joins: while
+            # a leader is mid-flight the counter has already advanced, so
+            # late arrivals rendezvous in the NEXT generation instead of
+            # being absorbed into one they never synchronized with
+            self._wb_waiting.add(worker_id)
+            while True:
+                if self._wb_done > gen:
+                    err = self._wb_errs.get(gen)
+                    if err is not None:  # leader's cross-process failure
+                        raise RuntimeError(
+                            f"worker barrier generation {gen} failed at "
+                            f"the leader") from err
+                    return
+                if (not self._wb_leading and self._wb_gen == gen
+                        and self._wb_waiting >= self._wb_active_ids()):
+                    # freeze this generation's membership and open the next
+                    self._wb_leading = True
+                    self._wb_gen += 1
+                    self._wb_waiting = set()
+                    break  # this thread leads the global phase
+                self._wb_cond.wait()
+        err = None
+        try:
+            self.barrier()
+        except BaseException as e:  # noqa: BLE001 — followers must see it
+            err = e
+        with self._wb_cond:
+            self._wb_leading = False
+            self._wb_done = gen + 1
+            if err is not None:
+                self._wb_errs[gen] = err
+                # prune: followers read their gen's error promptly; only a
+                # bounded window is kept
+                for g in [g for g in self._wb_errs if g < gen - 8]:
+                    del self._wb_errs[g]
+            self._wb_cond.notify_all()
+        if err is not None:
+            raise err
+
     def barrier(self) -> None:
         """Process barrier. Single-controller: flush dispatch. Multi-host:
         control-plane barrier (parallel/control.py replaces the reference's
@@ -1124,7 +1203,9 @@ class Worker:
     # -- API: lifecycle -------------------------------------------------------
 
     def barrier(self) -> None:
-        self.server.barrier()
+        """Barrier with every other active worker (all threads, all
+        processes) — reference ColoKVWorker::Barrier."""
+        self.server.worker_barrier(self.worker_id)
 
     def begin_setup(self) -> None:
         """Bracket initialization (reference BeginSetup/EndSetup): sync is
@@ -1141,3 +1222,6 @@ class Worker:
         self.wait_all()
         self._clock = WORKER_FINISHED
         self.server._clocks[self.worker_id] = WORKER_FINISHED
+        # workers blocked in a barrier must re-evaluate the participant set
+        with self.server._wb_cond:
+            self.server._wb_cond.notify_all()

@@ -41,4 +41,8 @@ python -m adapm_tpu.launcher -n 2 --no-keepalive -- \
   --synthetic_triples 400 --epochs 2 --batch_size 32 --eval_every 2 \
   --eval_triples 40 $FAST
 
+echo "=== bindings apps (CTR + GCN, adapm-pytorch-apps workload shapes) ==="
+PYTHONPATH=. python examples/ctr_example.py
+PYTHONPATH=. python examples/gcn_example.py
+
 echo "ALL APPS PASSED"

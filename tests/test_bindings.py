@@ -89,7 +89,12 @@ def test_sampling_log_uniform(server):
     assert np.median(keys) < 25
 
 
-def test_misc_api(server):
+def test_misc_api():
+    # 1 declared worker thread: barrier() is a rendezvous over ALL declared
+    # workers (reference kWorkerThreadGroup barrier counts nodes x threads,
+    # src/postoffice.cc:62-65), so only the sole worker may call it here
+    adapm.setup(50, 1)
+    server = adapm.Server(4, num_keys=50)
     w = adapm.Worker(0, server)
     assert w.num_keys == 50
     assert w.get_key_size(3) == 4
@@ -98,6 +103,7 @@ def test_misc_api(server):
     w.barrier()
     assert server.my_rank() == 0
     adapm.scheduler(50, 2)  # no-op, must not raise
+    server.shutdown()
 
 
 def test_per_key_value_lengths():
@@ -118,6 +124,20 @@ def test_per_key_value_lengths():
 def test_example_runs():
     """The bundled example (reference bindings/example.py analog)."""
     import examples.bindings_example as ex
+    ex.main()
+
+
+def test_ctr_example_runs():
+    """FM-over-sparse-features CTR app through the bindings (the
+    adapm-pytorch-apps CTR workload shape, reference README.md:23)."""
+    import examples.ctr_example as ex
+    ex.main()
+
+
+def test_gcn_example_runs():
+    """GCN node classification through the bindings (the
+    adapm-pytorch-apps GCN workload shape, reference README.md:23)."""
+    import examples.gcn_example as ex
     ex.main()
 
 

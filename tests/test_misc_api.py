@@ -57,3 +57,62 @@ def test_pull_if_local():
         ok, vals = w.pull_if_local(remote[:1])
         assert not ok and vals is None
     srv.shutdown()
+
+
+def test_worker_barrier_rendezvous():
+    """Worker.barrier synchronizes ALL worker threads (reference
+    ColoKVWorker::Barrier is a barrier over the worker group, not just
+    processes): no thread passes the barrier before every active worker
+    arrives."""
+    import threading
+
+    srv = adapm_tpu.setup(8, 2, num_workers=3,
+                          opts=SystemOptions(sync_max_per_sec=0))
+    ws = [srv.make_worker(i) for i in range(3)]
+    arrived = []
+    passed = []
+    lock = threading.Lock()
+
+    def run(i):
+        if i == 2:
+            # last worker delays: nobody may pass before it arrives
+            import time
+            time.sleep(0.2)
+        with lock:
+            arrived.append(i)  # arrival AT the barrier, not thread start
+        ws[i].barrier()
+        with lock:
+            assert len(arrived) == 3, \
+                "a worker passed the barrier before all arrived"
+            passed.append(i)
+
+    ts = [threading.Thread(target=run, args=(i,)) for i in range(3)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert sorted(passed) == [0, 1, 2]
+    srv.shutdown()
+
+
+def test_worker_barrier_excludes_finalized():
+    """A worker that finalizes while others wait at a barrier is removed
+    from the participant set (otherwise mixed-lifetime apps deadlock)."""
+    import threading
+
+    srv = adapm_tpu.setup(8, 2, num_workers=2,
+                          opts=SystemOptions(sync_max_per_sec=0))
+    w0, w1 = srv.make_worker(0), srv.make_worker(1)
+    done = threading.Event()
+
+    def waiter():
+        w0.barrier()
+        done.set()
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    assert not done.wait(0.2), "barrier must hold until w1 acts"
+    w1.finalize()
+    assert done.wait(5.0), "finalize must release the barrier"
+    t.join()
+    srv.shutdown()

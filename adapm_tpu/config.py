@@ -47,6 +47,10 @@ class SystemOptions:
     # -- store geometry
     cache_slots_per_shard: int = 0   # 0 = auto (num_keys // num_shards)
     remote_bucket_min: int = 8       # min padded size of the remote op bucket
+    # main-pool headroom factor for relocations (slots per shard =
+    # keys_per_shard * over_alloc); at memory-bound scale (e.g. a
+    # Wikidata5M-sized table filling most of HBM) set close to 1.0
+    main_over_alloc: float = 1.25
 
     # -- observability (sys.stats.*, sys.trace.*)
     stats_out: Optional[str] = None
@@ -79,6 +83,8 @@ class SystemOptions:
                        default=0.0)
         g.add_argument("--sys.sync.threshold", dest="sys_sync_threshold",
                        type=float, default=0.0)
+        g.add_argument("--sys.main_over_alloc", dest="sys_main_over_alloc",
+                       type=float, default=1.25)
         g.add_argument("--sys.stats.out", dest="sys_stats_out", default=None)
         g.add_argument("--sys.trace.keys", dest="sys_trace_keys", default=None)
         g.add_argument("--sys.stats.locality", dest="sys_stats_locality",
@@ -108,6 +114,7 @@ class SystemOptions:
             sync_max_per_sec=args.sys_sync_max_per_sec,
             sync_pause_ms=args.sys_sync_pause,
             sync_threshold=args.sys_sync_threshold,
+            main_over_alloc=args.sys_main_over_alloc,
             stats_out=args.sys_stats_out,
             trace_keys=args.sys_trace_keys,
             locality_stats=args.sys_stats_locality,

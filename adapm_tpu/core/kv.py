@@ -106,6 +106,7 @@ class Server:
                 cache_slots = min(2 * fair, int(class_counts[cid]))
             self.stores.append(ShardedStore(
                 int(class_counts[cid]), L, self.ctx, dtype=self.dtype,
+                over_alloc=self.opts.main_over_alloc,
                 cache_slots_per_shard=cache_slots,
                 bucket_min=self.opts.remote_bucket_min))
         self.ab = Addressbook(

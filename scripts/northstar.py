@@ -1,0 +1,214 @@
+"""North-star scale runs on one TPU chip (driver targets, BASELINE.json):
+
+  kge  — Wikidata5M-sized ComplEx: 4.6M entities / 822 relations, d=128,
+         B=4096, 32 negatives; reports ms/step and the derived epoch time
+         over Wikidata5M's 20.6M train triples.
+  w2v  — 1B-words-sized SGNS: 800k vocab (the benchmark corpus' min-count-5
+         vocabulary), d=128, B=8192 pairs, 5 negatives with on-device
+         unigram^0.75 alias sampling; reports pairs/s.
+  mf   — MovieLens-25M-sized: 162,541 users x 59,047 movies, rank 128,
+         B=16384 ratings; reports updates/s and derived epoch time over
+         25M ratings.
+
+Each run drives the same PM loop as bench.py (intent for the next batch +
+a planner round per step, device-routed fused step) at full table scale —
+the point is the table SIZE (the KGE table fills most of a v5e chip's
+HBM; `--sys.main_over_alloc` close to 1 trades relocation headroom for
+fitting), not new machinery. Timing is slope-based (docs/PERF.md
+"Measurement methodology"). Prints one JSON line per workload.
+
+Usage: python scripts/northstar.py [kge w2v mf]
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+from functools import partial
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+
+def progress(msg: str) -> None:
+    print(f"[northstar +{time.perf_counter() - T0:7.1f}s] {msg}",
+          file=sys.stderr, flush=True)
+
+
+T0 = time.perf_counter()
+
+
+def bulk_device_init(store, emb_cols: int, scale: float, seed: int) -> None:
+    """Fill a store's whole main pool on device: normal(0, scale) embedding
+    columns, 1e-6 optimizer-state columns. Slot assignment is irrelevant —
+    every slot gets an i.i.d. row, so this equals a per-key host init in
+    distribution while skipping the host->HBM transfer entirely (a 4.6M x
+    512 table inits in milliseconds instead of minutes)."""
+    import jax
+    import jax.numpy as jnp
+
+    S, M, L = store.main.shape
+    slab = min(M, 262_144)
+
+    @partial(jax.jit, donate_argnums=0)
+    def fill(main, key, lo):
+        r = jax.random.normal(key, (S, slab, L), main.dtype) * scale
+        r = r.at[:, :, emb_cols:].set(1e-6)
+        return jax.lax.dynamic_update_slice(main, r, (0, lo, 0))
+
+    key = jax.random.PRNGKey(seed)
+    lo = 0
+    while lo < M:
+        key, sub = jax.random.split(key)
+        # dynamic_update_slice clamps the final slab to [M-slab, M)
+        store.main = fill(store.main, sub, jnp.int32(min(lo, M - slab)))
+        lo += slab
+    store.block()
+
+
+def skewed(rng, n, size):
+    return (n * rng.random(size) ** 3).astype(np.int64).clip(0, n - 1)
+
+
+def slope_time(step, steps: int):
+    """(T_long - T_short) / (steps - steps//4); step(i) must end in a
+    host-visible value only when asked (see bench.py)."""
+    def timed(n):
+        t0 = time.perf_counter()
+        out = None
+        for i in range(n):
+            out = step(i)
+        float(out)
+        return time.perf_counter() - t0
+
+    timed(1)
+    t_s = timed(steps // 4)
+    t_l = timed(steps)
+    return (t_l - t_s) / (steps - steps // 4)
+
+
+def pm_loop(srv, w, runner, batches, aux, lr, steps, warmup):
+    """The bench.py PM step shape: intent for the NEXT batch, fused step,
+    one planner round, clock tick."""
+    nb = len(batches)
+    intent_keys = [np.unique(np.concatenate([v.ravel() for v in b.values()]))
+                   for b in batches]
+
+    def step(i):
+        nxt = (i + 1) % nb
+        w.intent(intent_keys[nxt], w.current_clock + 1, w.current_clock + 2)
+        loss = runner(batches[i % nb], None if aux is None else aux[i % nb],
+                      lr)
+        srv.sync.run_round()
+        w.advance_clock()
+        return loss
+
+    for _ in range(warmup):
+        step(0)
+    return slope_time(step, steps)
+
+
+def run_kge(E=4_600_000, R=822, d=128, B=4096, N=32, steps=16,
+            train_triples=20_614_279):
+    import adapm_tpu
+    from adapm_tpu.config import SystemOptions
+    from adapm_tpu.models import make_kge_loss
+    from adapm_tpu.ops import DeviceRoutedRunner
+
+    progress(f"kge: building server ({E + R} keys x {4 * d} f32 = "
+             f"{(E + R) * 4 * d * 4 / 2**30:.1f} GiB main pool)")
+    srv = adapm_tpu.setup(E + R, 4 * d, opts=SystemOptions(
+        cache_slots_per_shard=1, sync_max_per_sec=0, main_over_alloc=1.02))
+    bulk_device_init(srv.stores[0], 2 * d, 0.1, seed=0)
+    progress("kge: init done (device bulk init)")
+    w = srv.make_worker(0)
+    runner = DeviceRoutedRunner(
+        srv, make_kge_loss("complex"),
+        role_class={"s": 0, "r": 0, "o": 0, "neg": 0},
+        role_dim={k: 2 * d for k in ("s", "r", "o", "neg")},
+        neg_role="neg", neg_shape=(B, N), neg_population=np.arange(E))
+    rng = np.random.default_rng(0)
+    batches = [{"s": skewed(rng, E, B),
+                "r": rng.integers(E, E + R, B).astype(np.int64),
+                "o": skewed(rng, E, B)} for _ in range(4)]
+    progress("kge: compiling + warmup")
+    dt = pm_loop(srv, w, runner, batches, None, 0.1, steps, warmup=3)
+    srv.shutdown()
+    epoch_s = dt * train_triples / B
+    return {"metric": "northstar_kge_wikidata5m_scale",
+            "entities": E, "relations": R, "dim": d,
+            "ms_per_step": round(dt * 1e3, 2),
+            "triples_per_sec": round(B / dt, 1),
+            "derived_epoch_s_20.6M_triples": round(epoch_s, 1)}
+
+
+def run_w2v(V=800_000, d=128, B=8192, N=5, steps=24):
+    import adapm_tpu
+    from adapm_tpu.config import SystemOptions
+    from adapm_tpu.models.sgns import build_alias_table, sgns_loss, syn1_key
+    from adapm_tpu.ops import DeviceRoutedRunner
+
+    progress(f"w2v: building server ({2 * V} keys x {2 * d} f32)")
+    srv = adapm_tpu.setup(2 * V, 2 * d, opts=SystemOptions(
+        cache_slots_per_shard=1, sync_max_per_sec=0))
+    bulk_device_init(srv.stores[0], d, 0.05, seed=1)
+    w = srv.make_worker(0)
+    counts = 1.0 / (np.arange(V) + 10.0)  # zipf corpus frequencies
+    runner = DeviceRoutedRunner(
+        srv, sgns_loss, role_class={"center": 0, "ctx": 0, "neg": 0},
+        role_dim={k: d for k in ("center", "ctx", "neg")},
+        neg_role="neg", neg_shape=(B, N),
+        neg_population=syn1_key(np.arange(V)),
+        neg_alias=build_alias_table(counts))
+    rng = np.random.default_rng(1)
+    batches = [{"center": 2 * skewed(rng, V, B),
+                "ctx": 2 * skewed(rng, V, B) + 1} for _ in range(4)]
+    progress("w2v: compiling + warmup")
+    dt = pm_loop(srv, w, runner, batches, None, 0.05, steps, warmup=3)
+    srv.shutdown()
+    return {"metric": "northstar_w2v_1bwords_scale", "vocab": V, "dim": d,
+            "ms_per_step": round(dt * 1e3, 2),
+            "pairs_per_sec": round(B / dt, 1)}
+
+
+def run_mf(users=162_541, movies=59_047, rank=128, B=16_384, steps=24,
+           ratings=25_000_095):
+    import adapm_tpu
+    from adapm_tpu.config import SystemOptions
+    from adapm_tpu.models import make_mf_loss
+    from adapm_tpu.ops import DeviceRoutedRunner
+
+    K = users + movies
+    progress(f"mf: building server ({K} keys x {2 * rank} f32)")
+    srv = adapm_tpu.setup(K, 2 * rank, opts=SystemOptions(
+        cache_slots_per_shard=1, sync_max_per_sec=0))
+    bulk_device_init(srv.stores[0], rank, 0.1, seed=2)
+    w = srv.make_worker(0)
+    runner = DeviceRoutedRunner(
+        srv, make_mf_loss(l2=0.01), role_class={"w": 0, "h": 0},
+        role_dim={"w": rank, "h": rank})
+    rng = np.random.default_rng(2)
+    batches = [{"w": skewed(rng, users, B),
+                "h": users + skewed(rng, movies, B)} for _ in range(4)]
+    aux = [rng.random(B).astype(np.float32) * 4 + 1 for _ in range(4)]
+    progress("mf: compiling + warmup")
+    dt = pm_loop(srv, w, runner, batches, aux, 0.05, steps, warmup=3)
+    srv.shutdown()
+    return {"metric": "northstar_mf_movielens25m_scale",
+            "users": users, "movies": movies, "rank": rank,
+            "ms_per_step": round(dt * 1e3, 2),
+            "ratings_per_sec": round(B / dt, 1),
+            "derived_epoch_s_25M_ratings": round(dt * ratings / B, 1)}
+
+
+def main():
+    which = sys.argv[1:] or ["kge", "w2v", "mf"]
+    runs = {"kge": run_kge, "w2v": run_w2v, "mf": run_mf}
+    for name in which:
+        out = runs[name]()
+        print(json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -28,7 +28,10 @@ from functools import partial
 
 import numpy as np
 
-sys.path.insert(0, ".")
+import os
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
 
 
 def progress(msg: str) -> None:
@@ -74,6 +77,8 @@ def skewed(rng, n, size):
 def slope_time(step, steps: int):
     """(T_long - T_short) / (steps - steps//4); step(i) must end in a
     host-visible value only when asked (see bench.py)."""
+    assert steps >= 4, "slope timing needs steps >= 4 (two loop lengths)"
+
     def timed(n):
         t0 = time.perf_counter()
         out = None

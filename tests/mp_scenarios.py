@@ -389,6 +389,46 @@ def scenario_stress():
     print(f"MP-OK stress rank={rank}")
 
 
+def scenario_sampling():
+    """Sampling across processes (reference tests/test_sampling.cc run under
+    dmlc_local with multiple nodes, run_tests.sh:21-42): every scheme draws
+    keys whose main copies live on OTHER processes; sampled values must be
+    exact (value[0] == key), WOR draws unique, and the Local scheme must
+    only ever return process-locally-resident keys."""
+    scheme = sys.argv[2]
+    K = 48
+    srv = adapm_tpu.setup(K, 4, opts=SystemOptions(
+        sync_max_per_sec=0, sampling_scheme=scheme,
+        sampling_with_replacement=False))
+    rank = control.process_id()
+    w = srv.make_worker(0)
+    keys = np.arange(K, dtype=np.int64)
+    if rank == 0:  # value[0] = key, recognizable everywhere
+        vals = np.zeros((K, 4), np.float32)
+        vals[:, 0] = keys
+        w.wait(w.set(keys, vals))
+    srv.barrier()
+    srv.enable_sampling_support(
+        lambda n, rng: rng.integers(0, K, n).astype(np.int64))
+    h = w.prepare_sample(12)
+    if scheme == "preloc":
+        srv.wait_sync()  # act on the signalled intent (replicate/relocate)
+    drawn, vals = w.pull_sample(h)
+    assert len(drawn) == 12
+    np.testing.assert_allclose(vals[:, 0], drawn.astype(np.float32))
+    assert len(np.unique(drawn)) == len(drawn), "WOR produced duplicates"
+    if scheme == "local":
+        # Local draws only process-resident keys (reference
+        # sampling.h:476-505 probes the local store)
+        loc = (srv.ab.owner[drawn] >= 0) | \
+            (srv.ab.cache_slot[:, drawn] >= 0).any(axis=0)
+        assert loc.all(), f"local scheme drew non-resident keys {drawn[~loc]}"
+    w.finish_sample(h)
+    srv.barrier()
+    srv.shutdown()
+    print(f"MP-OK sampling rank={rank}")
+
+
 def scenario_bindings():
     """The torch/numpy bindings surface works across launched processes
     (the reference's bindings example runs 4 simulated nodes —
@@ -452,6 +492,7 @@ SCENARIOS = {
     "ckpt_save": scenario_ckpt_save,
     "ckpt_restore": scenario_ckpt_restore,
     "heartbeat": scenario_heartbeat,
+    "sampling": scenario_sampling,
     "kge_app": scenario_kge_app,
     "bindings": scenario_bindings,
     "stress": scenario_stress,

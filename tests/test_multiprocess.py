@@ -131,3 +131,10 @@ def test_mp_location_caches_off():
     """--sys.location_caches 0: hint table stays cold, routing still
     converges via the manager."""
     run_mp(3, "location_caches", devices=1, args=(0,))
+
+
+@pytest.mark.parametrize("scheme", ["naive", "preloc", "pool", "local"])
+def test_mp_sampling_schemes(scheme):
+    """All four sampling schemes draw remotely-owned keys correctly across
+    processes (reference run_tests.sh sampling-scheme variants)."""
+    run_mp(3, "sampling", devices=1, args=(scheme,))

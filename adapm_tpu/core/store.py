@@ -52,7 +52,12 @@ def pad_to(arr: np.ndarray, size: int, fill) -> np.ndarray:
 
 def pad_bucket(n: int, *arrays_and_fills, minimum: int = 8):
     b = bucket_size(n, minimum)
-    return [jnp.asarray(pad_to(a, b, fill)) for a, fill in arrays_and_fills]
+    # numpy (uncommitted) on purpose: jit places numpy args directly with
+    # each executable's expected sharding. A jnp.asarray here would commit
+    # them to device 0, and every mesh-jitted op would then RESHARD them
+    # host-side per call (measured: ~10x slowdown of planner device ops on
+    # an 8-device mesh, profile dominated by Array._value readbacks).
+    return [pad_to(a, b, fill) for a, fill in arrays_and_fills]
 
 
 # ---------------------------------------------------------------------------
@@ -222,9 +227,12 @@ class ShardedStore:
             jnp.zeros((S, self.cache_slots, value_length), dtype), sh)
 
     def _vals_bucket(self, vals, bucket: int):
-        v = jnp.zeros((bucket, self.value_length), self.dtype)
+        # numpy (uncommitted) for the same reason as pad_bucket: a device-0
+        # committed array would be host-resharded by every mesh-jitted op
+        v = np.zeros((bucket, self.value_length), dtype=self.dtype)
         n = vals.shape[0]
-        return v.at[:n].set(jnp.asarray(vals, self.dtype))
+        v[:n] = np.asarray(vals)
+        return v
 
     # index-level ops (all index arrays are np.int32, padded by caller or
     # padded here via pad_bucket)

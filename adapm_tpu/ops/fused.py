@@ -98,8 +98,9 @@ def build_routes(server, keys: np.ndarray, shard: int,
     server.ensure_local(keys, shard)
     o_sh, o_sl, c_sh, c_sl, use_c, n_remote = server._route(keys, shard)
     g_sl = np.where(use_c, OOB, o_sl).astype(np.int32)
-    return Routes(jnp.asarray(o_sh), jnp.asarray(g_sl), jnp.asarray(c_sh),
-                  jnp.asarray(c_sl), jnp.asarray(use_c), n_remote)
+    put = server.ctx.put_replicated  # the staging rule, mesh.py
+    return Routes(put(o_sh), put(g_sl), put(c_sh), put(c_sl), put(use_c),
+                  n_remote)
 
 
 def _read_rows(main, cache, delta, route):
@@ -190,9 +191,10 @@ class DeviceRouter:
         if self._version == srv.topology_version and self.owner is not None:
             return
         ab = srv.ab
-        self.owner = jnp.asarray(ab.owner)
-        self.slot = jnp.asarray(ab.slot)
-        self.cache_row = jnp.asarray(ab.cache_slot[self.shard])
+        put = srv.ctx.put_replicated  # the staging rule, mesh.py
+        self.owner = put(ab.owner)
+        self.slot = put(ab.slot)
+        self.cache_row = put(ab.cache_slot[self.shard])
         self._version = srv.topology_version
 
     def tables(self):
@@ -356,8 +358,8 @@ class DeviceRoutedRunner:
                                    dtype=_key_dtype(server.num_keys))
             assert len(prob) == len(key_table), \
                 "alias table must cover the population"
-            self._alias = (jnp.asarray(prob), jnp.asarray(alias),
-                           jnp.asarray(key_table))
+            put = server.ctx.put_replicated
+            self._alias = (put(prob), put(alias), put(key_table))
         # population the device sampler may draw from (Local scheme: the
         # locally-resident slice of the allowed keys); None -> all keys
         self._neg_population = None if neg_population is None else \
@@ -414,7 +416,7 @@ class DeviceRoutedRunner:
         kdt = _key_dtype(srv.num_keys)
         padded = np.full(cap, np.iinfo(kdt).max, dtype=kdt)
         padded[: len(idx)] = idx
-        self._local_index = (jnp.asarray(padded),
+        self._local_index = (srv.ctx.put_replicated(padded),
                              jnp.int32(len(idx)))
         self._li_version = srv.topology_version
         return self._local_index
@@ -449,7 +451,8 @@ class DeviceRoutedRunner:
             self._rng, sub = jax.random.split(self._rng)
             # keys validated above to be inside [0, num_keys)
             kdtype = _key_dtype(srv.num_keys)
-            keys = {r: jnp.asarray(np.asarray(k, dtype=kdtype))
+            put = srv.ctx.put_replicated  # the staging rule, mesh.py
+            keys = {r: put(np.asarray(k, dtype=kdtype))
                     for r, k in role_keys.items()}
             pools = tuple((s.main, s.cache, s.delta) for s in srv.stores)
             fn = self.step_fn if self._shard_has_replicas() \

@@ -43,7 +43,21 @@ class MeshContext:
         return NamedSharding(self.mesh, P(KV_AXIS))
 
     def replicated(self) -> NamedSharding:
-        return NamedSharding(self.mesh, P())
+        if not hasattr(self, "_replicated"):
+            object.__setattr__(self, "_replicated",
+                               NamedSharding(self.mesh, P()))
+        return self._replicated
+
+    def put_replicated(self, arr):
+        """Stage a host array for jitted programs: committed + replicated.
+        This is THE staging rule (docs/PERF.md "Host-array staging"): a
+        device-0 `jnp.asarray` gets host-resharded by every mesh-compiled
+        executable per call, and a bare numpy arg uploads synchronously
+        inside dispatch on remote-attached backends; a replicated
+        device_put is asynchronous and already in the sharding
+        executables expect."""
+        import numpy as np
+        return jax.device_put(np.asarray(arr), self.replicated())
 
 
 def make_mesh(num_shards: Optional[int] = None,

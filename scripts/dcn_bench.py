@@ -1,0 +1,130 @@
+"""Cross-process data-plane benchmark: what the DCN channel + GlobalPM
+sustain between launched processes (the reference's ZMQ van numbers
+analog — bytes and keys/s for remote Pull/Push and replica sync rounds).
+
+Self-launches N processes through the launcher when run directly:
+
+    python scripts/dcn_bench.py [n_procs]
+
+Each rank times, against keys homed on the next rank:
+  - remote pull  (keys/s, MiB/s)  — GlobalPM.request_pull round trips
+  - remote push  (keys/s, MiB/s)  — GlobalPM.request_write round trips
+  - sync rounds  (keys/s)         — replicate a working set via intent,
+    then time planner rounds that extract deltas, ship them, and install
+    fresh bases (pm.sync_replicas)
+
+Rank 0 prints one JSON line. Results recorded in docs/PERF.md ("DCN
+data plane"). CPU platform: this path is host+DCN-bound by design — the
+numbers transfer to TPU hosts, whose data plane is the same code.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+K = 200_000
+L = 64          # f32 per key -> 256 B values, the reference's mid-size rows
+BATCH = 4096
+ROUNDS = 20
+
+
+def child() -> None:
+    os.environ.setdefault("ADAPM_PLATFORM", "cpu")
+    import adapm_tpu
+    from adapm_tpu.config import SystemOptions
+    from adapm_tpu.parallel import control
+
+    srv = adapm_tpu.setup(K, L, opts=SystemOptions(sync_max_per_sec=0))
+    rank = control.process_id()
+    P = control.num_processes()
+    w = srv.make_worker(0)
+    rng = np.random.default_rng(rank)
+    pm = srv.glob
+
+    keys = np.arange(K, dtype=np.int64)
+    theirs = keys[pm.home_proc(keys) == (rank + 1) % P]
+    srv.barrier()
+
+    def timed(fn, n=ROUNDS):
+        fn()  # warm (routing caches, lazy conns)
+        t0 = time.perf_counter()
+        for _ in range(n):
+            fn()
+        return (time.perf_counter() - t0) / n
+
+    batch = rng.choice(theirs, BATCH, replace=False)
+    vals = np.ones(BATCH * L, np.float32)
+
+    t_pull = timed(lambda: pm.request_pull(batch))
+    t_push = timed(lambda: pm.request_write(batch, vals, is_set=False))
+
+    # replicate the batch here: the OWNER rank must hold competing
+    # interest first (exclusive intent would relocate instead —
+    # sync_manager.h:624-644), so every rank intents its own keys, then
+    # the cross intents are granted as replicas
+    mine = keys[pm.home_proc(keys) == rank]
+    w.intent(mine, w.current_clock, w.current_clock + 10_000)
+    srv.wait_sync()
+    srv.barrier()
+    w.intent(batch, w.current_clock, w.current_clock + 10_000)
+    srv.wait_sync()
+    items = [(int(k), w.shard) for k in batch]
+    assert (srv.ab.cache_slot[w.shard, batch] >= 0).mean() > 0.9, \
+        "expected the working set to be replicated"
+    t_sync = timed(lambda: pm.sync_replicas(items))
+
+    srv.barrier()
+    mib = BATCH * L * 4 / 2**20
+    out = {
+        "metric": "dcn_data_plane",
+        "procs": P, "batch": BATCH, "value_bytes": L * 4,
+        "pull_keys_per_s": round(BATCH / t_pull),
+        "pull_MiB_per_s": round(mib / t_pull, 1),
+        "push_keys_per_s": round(BATCH / t_push),
+        "push_MiB_per_s": round(mib / t_push, 1),
+        "sync_round_ms": round(t_sync * 1e3, 2),
+        "sync_keys_per_s": round(BATCH / t_sync),
+    }
+    if rank == 0:
+        print(json.dumps(out), flush=True)
+    srv.barrier()
+    srv.shutdown()
+
+
+def main() -> None:
+    if os.environ.get("ADAPM_PROCESS_ID") is not None:
+        child()
+        return
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 2
+    from adapm_tpu import launcher
+    env = dict(os.environ)
+    env["ADAPM_PLATFORM"] = "cpu"
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PYTHONPATH", None)
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))
+    import subprocess
+    coordinator = f"localhost:{launcher.free_port()}"
+    procs = [subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__)],
+        env=launcher.make_env(r, n, coordinator, env))
+        for r in range(n)]
+    rc = []
+    try:
+        rc = [p.wait(timeout=420) for p in procs]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    assert all(c == 0 for c in rc), rc
+
+
+if __name__ == "__main__":
+    main()

@@ -133,6 +133,7 @@ def test_mp_location_caches_off():
     run_mp(3, "location_caches", devices=1, args=(0,))
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("scheme", ["naive", "preloc", "pool", "local"])
 def test_mp_sampling_schemes(scheme):
     """All four sampling schemes draw remotely-owned keys correctly across

@@ -223,6 +223,13 @@ def run_app(args) -> dict:
         ds = kgeio.load_dataset(args.train, args.valid, args.test,
                                 args.num_entities or None,
                                 args.num_relations or None)
+    elif args.synthetic_mode == "lowrank":
+        ds, truth_mrr = kgeio.generate_lowrank(
+            num_entities=args.synthetic_entities,
+            num_relations=args.synthetic_relations,
+            n_train=args.synthetic_triples, seed=args.seed)
+        alog(f"[kge] lowrank synthetic: generating-model filtered "
+             f"MRR ceiling = {truth_mrr:.4f}")
     else:
         ds = kgeio.generate_synthetic(
             num_entities=args.synthetic_entities,
@@ -378,6 +385,10 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--synthetic_entities", type=int, default=120)
     parser.add_argument("--synthetic_relations", type=int, default=8)
     parser.add_argument("--synthetic_triples", type=int, default=1500)
+    parser.add_argument("--synthetic_mode", default="permutation",
+                        choices=["permutation", "lowrank"],
+                        help="lowrank = drawn from a ground-truth ComplEx "
+                             "model (learnable by construction)")
     parser.add_argument("--lookahead", type=int, default=4,
                         help="intent/sample batches ahead (kge.cc :1059)")
     parser.add_argument("--device_routes",

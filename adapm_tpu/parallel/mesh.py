@@ -44,8 +44,7 @@ class MeshContext:
 
     def replicated(self) -> NamedSharding:
         if not hasattr(self, "_replicated"):
-            object.__setattr__(self, "_replicated",
-                               NamedSharding(self.mesh, P()))
+            self._replicated = NamedSharding(self.mesh, P())
         return self._replicated
 
     def put_replicated(self, arr):
@@ -56,7 +55,6 @@ class MeshContext:
         inside dispatch on remote-attached backends; a replicated
         device_put is asynchronous and already in the sharding
         executables expect."""
-        import numpy as np
         return jax.device_put(np.asarray(arr), self.replicated())
 
 

@@ -44,6 +44,8 @@ def child() -> None:
     srv = adapm_tpu.setup(K, L, opts=SystemOptions(sync_max_per_sec=0))
     rank = control.process_id()
     P = control.num_processes()
+    assert P >= 2, "dcn_bench measures the CROSS-process data plane; " \
+                   "launch with >= 2 processes"
     w = srv.make_worker(0)
     rng = np.random.default_rng(rank)
     pm = srv.glob
@@ -107,7 +109,6 @@ def main() -> None:
     env = dict(os.environ)
     env["ADAPM_PLATFORM"] = "cpu"
     env["JAX_PLATFORMS"] = "cpu"
-    env.pop("PYTHONPATH", None)
     env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
         os.path.abspath(__file__)))
     import subprocess

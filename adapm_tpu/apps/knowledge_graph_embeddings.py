@@ -219,6 +219,7 @@ def evaluate(run: KgeRun, triples: np.ndarray, batch: int = 64):
 
 
 def run_app(args) -> dict:
+    truth_mrr = None
     if args.train:
         ds = kgeio.load_dataset(args.train, args.valid, args.test,
                                 args.num_entities or None,
@@ -273,6 +274,8 @@ def run_app(args) -> dict:
     guard = RuntimeGuard(args.max_runtime)
     watch = Stopwatch(start=True)
     result = {}
+    if truth_mrr is not None:
+        result["truth_mrr"] = truth_mrr
 
     for epoch in range(args.epochs):
         # losses stay device scalars until epoch end: a float() per step

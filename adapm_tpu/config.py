@@ -34,6 +34,11 @@ class SystemOptions:
     #    0 = off, matching the reference's default)
     heartbeat_s: float = 0.0
 
+    # -- cross-process channel concurrency (reference --sys.zmq_threads,
+    #    coloc_kv_server.h:208): read-executor width of the GlobalPM;
+    #    write executors get half (writes are ordered per worker anyway)
+    dcn_threads: int = 8
+
     # -- sync throttling (sys.sync.*)
     sync_max_per_sec: float = 1000.0
     sync_pause_ms: float = 0.0
@@ -77,6 +82,8 @@ class SystemOptions:
                        type=int, default=1)
         g.add_argument("--sys.heartbeat", dest="sys_heartbeat",
                        type=float, default=0.0)
+        g.add_argument("--sys.dcn_threads", dest="sys_dcn_threads",
+                       type=int, default=8)
         g.add_argument("--sys.sync.max_per_sec", dest="sys_sync_max_per_sec",
                        type=float, default=1000.0)
         g.add_argument("--sys.sync.pause", dest="sys_sync_pause", type=float,
@@ -111,6 +118,7 @@ class SystemOptions:
             location_caches=bool(args.sys_location_caches),
             time_intent_actions=bool(args.sys_time_intent_actions),
             heartbeat_s=args.sys_heartbeat,
+            dcn_threads=args.sys_dcn_threads,
             sync_max_per_sec=args.sys_sync_max_per_sec,
             sync_pause_ms=args.sys_sync_pause,
             sync_threshold=args.sys_sync_threshold,

@@ -157,10 +157,12 @@ class GlobalPM:
         self.chan = DcnChannel(self.pid, self.num_procs, self._handle)
         self.chan.start()
         # separate pools: pull tasks may block on write futures, so writes
-        # must never queue behind blocked pulls
-        self._exec_r = ThreadPoolExecutor(max_workers=8,
+        # must never queue behind blocked pulls. Widths follow
+        # --sys.dcn_threads (reference --sys.zmq_threads analog)
+        nt = max(1, int(server.opts.dcn_threads))
+        self._exec_r = ThreadPoolExecutor(max_workers=nt,
                                           thread_name_prefix="adapm-pm-r")
-        self._exec_w = ThreadPoolExecutor(max_workers=4,
+        self._exec_w = ThreadPoolExecutor(max_workers=max(2, nt // 2),
                                           thread_name_prefix="adapm-pm-w")
         control.barrier("pm-up")
 

@@ -36,7 +36,8 @@ class SystemOptions:
 
     # -- cross-process channel concurrency (reference --sys.zmq_threads,
     #    coloc_kv_server.h:208): read-executor width of the GlobalPM;
-    #    write executors get half (writes are ordered per worker anyway)
+    #    write executors get half, floored at 2 (a write task may wait on
+    #    an earlier write future, so one thread could self-block)
     dcn_threads: int = 8
 
     # -- sync throttling (sys.sync.*)

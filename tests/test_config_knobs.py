@@ -122,8 +122,12 @@ def test_dcn_threads_sizes_pm_executors():
     assert opts.dcn_threads == 3
     # the consumption site (parallel/pm.py) is covered by the mp suite;
     # source-level guard that the knob is not accepted-and-ignored: the
-    # CODE token (not a comment) must read the option
+    # option must be read on a CODE line (comments stripped)
     import inspect
 
     from adapm_tpu.parallel import pm
-    assert "opts.dcn_threads" in inspect.getsource(pm.GlobalPM.__init__)
+    code_lines = [ln.split("#", 1)[0]
+                  for ln in inspect.getsource(pm.GlobalPM.__init__)
+                  .splitlines()]
+    assert any("opts.dcn_threads" in ln for ln in code_lines), \
+        "--sys.dcn_threads is parsed but no code reads it"

@@ -1,6 +1,8 @@
-import os, time
+import os, sys, time
 os.environ["ADAPM_PLATFORM"] = "cpu"
 os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
 import jax; jax.config.update("jax_platforms", "cpu")
 import numpy as np
 import adapm_tpu

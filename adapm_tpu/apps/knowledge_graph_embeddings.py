@@ -78,7 +78,7 @@ class KgeRun:
         self.ent_class = int(ab.key_class[0])
         self.rel_class = int(ab.key_class[E])
         self.runner = FusedStepRunner(
-            self.srv, make_kge_loss(args.model),
+            self.srv, make_kge_loss(args.model, args.self_adv_temp),
             role_class={"s": self.ent_class, "r": self.rel_class,
                         "o": self.ent_class, "neg": self.ent_class},
             role_dim={"s": self.ent_dim, "r": self.rel_dim,
@@ -243,28 +243,50 @@ def run_app(args) -> dict:
 
     B, N = args.batch_size, args.neg_ratio
     srv, workers = run.srv, run.workers
-    # negative sampling: uniform entities (kge.cc draws uniform entities);
-    # the Local scheme may only snap within the entity key population
-    srv.enable_sampling_support(
-        lambda n, r: run.ekey(r.integers(0, run.E, n)),
-        allowed_keys=run.ekey(np.arange(run.E)))
+    # negative sampling over entities. uniform = the reference's scheme
+    # (kge.cc draws uniform entities); freq = unigram^pow over the
+    # training-triple entity frequencies (word2vec's noise distribution
+    # applied to KGE — hits the populated region of the entity space,
+    # part of the mid-scale fix alongside --self_adv_temp). The Local
+    # scheme may only snap within the entity key population.
+    neg_alias = None
+    if args.neg_sampling == "freq":
+        from ..models.sgns import build_alias_table
+        counts = (np.bincount(ds.train[:, 0], minlength=run.E)
+                  + np.bincount(ds.train[:, 2], minlength=run.E)
+                  + 1.0)
+        neg_alias = build_alias_table(counts, power=args.neg_freq_pow)
+
+        def host_neg(n, r):
+            prob, alias = neg_alias
+            u = r.integers(0, run.E, n)
+            keep = r.random(n) < prob[u]
+            return run.ekey(np.where(keep, u, alias[u]))
+
+        srv.enable_sampling_support(
+            host_neg, allowed_keys=run.ekey(np.arange(run.E)))
+    else:
+        srv.enable_sampling_support(
+            lambda n, r: run.ekey(r.integers(0, run.E, n)),
+            allowed_keys=run.ekey(np.arange(run.E)))
 
     # --device_routes: the production TPU hot path — routing tables and
-    # negative sampling (Local scheme) live on device; one runner per
-    # worker shard (docs/PERF.md: ~2.4x over host routing)
+    # negative sampling (Local scheme, uniform or alias-table freq) live
+    # on device; one runner per worker shard (docs/PERF.md: ~2.4x over
+    # host routing)
     dev_runners = {}
 
     def device_runner(shard: int) -> DeviceRoutedRunner:
         if shard not in dev_runners:
             dev_runners[shard] = DeviceRoutedRunner(
-                srv, make_kge_loss(args.model),
+                srv, make_kge_loss(args.model, args.self_adv_temp),
                 role_class={"s": run.ent_class, "r": run.rel_class,
                             "o": run.ent_class, "neg": run.ent_class},
                 role_dim={"s": run.ent_dim, "r": run.rel_dim,
                           "o": run.ent_dim, "neg": run.ent_dim},
                 shard=shard, neg_role="neg", neg_shape=(B, N),
                 neg_population=run.ekey(np.arange(run.E)),
-                seed=args.seed + shard)
+                neg_alias=neg_alias, seed=args.seed + shard)
         return dev_runners[shard]
 
     train = ds.train
@@ -399,6 +421,16 @@ def build_parser() -> argparse.ArgumentParser:
                         help="device-routed fused step + on-device "
                              "negative sampling (TPU hot path; default on,"
                              " --no-device_routes for host routing)")
+    parser.add_argument("--neg_sampling", default="uniform",
+                        choices=["uniform", "freq"],
+                        help="negative entity distribution: uniform "
+                             "(kge.cc) or unigram^pow over train-triple "
+                             "frequencies (mid-scale fix, docs/PERF.md)")
+    parser.add_argument("--neg_freq_pow", type=float, default=0.75,
+                        help="power for --neg_sampling freq")
+    parser.add_argument("--self_adv_temp", type=float, default=0.0,
+                        help="self-adversarial negative weighting "
+                             "temperature (RotatE eq. 5; 0 = off)")
     parser.add_argument("--init_scheme", default="normal",
                         choices=["normal", "uniform"])
     parser.add_argument("--init_scale", type=float, default=0.1)

@@ -219,8 +219,11 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--adagrad_init", type=float, default=1e-6)
     parser.add_argument("--bold_inc", type=float, default=1.05)
     parser.add_argument("--bold_dec", type=float, default=0.5)
-    parser.add_argument("--device_routes", action="store_true",
-                        help="device-routed fused step (TPU hot path)")
+    parser.add_argument("--device_routes",
+                        action=argparse.BooleanOptionalAction, default=True,
+                        help="device-routed fused step (TPU hot path; "
+                             "default on, --no-device_routes for host "
+                             "routing)")
     parser.add_argument("--init_w", default=None)
     parser.add_argument("--init_h", default=None)
     parser.add_argument("--export_prefix", default=None)

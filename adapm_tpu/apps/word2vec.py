@@ -232,9 +232,11 @@ def build_parser() -> argparse.ArgumentParser:
                              "(word2vec.cc --sample; 0 disables)")
     parser.add_argument("--readahead", type=int, default=1000,
                         help="sentences of intent/sample lookahead")
-    parser.add_argument("--device_routes", action="store_true",
+    parser.add_argument("--device_routes",
+                        action=argparse.BooleanOptionalAction, default=True,
                         help="device-routed fused step + in-program "
-                             "unigram^0.75 negatives (TPU hot path)")
+                             "unigram^0.75 negatives (TPU hot path; default "
+                             "on, --no-device_routes for host routing)")
     parser.add_argument("--adagrad_init", type=float, default=1e-6)
     parser.add_argument("--export_prefix", default=None)
     add_common_arguments(parser)

@@ -182,6 +182,9 @@ class Server:
             if traced is not None else None
         self.locality = LocalityStats(self.num_keys, self._native) \
             if self.opts.locality_stats else None
+        # device-routed runners register a counts callback here so the
+        # production path feeds locality_summary too (ops/fused.py)
+        self._locality_sources: List = []
         if self.tracer is not None:
             # initial allocation events, grouped by home shard (one record
             # call per shard, not per key)
@@ -780,12 +783,16 @@ class Server:
         workers at different times while other ranks still barrier is
         misusing the API (it would equally hang the reference's
         scheduler-counted barriers)."""
+        import time as _time
+
+        from ..utils import alog
         with self._wb_cond:
             gen = self._wb_gen  # the generation this arrival joins: while
             # a leader is mid-flight the counter has already advanced, so
             # late arrivals rendezvous in the NEXT generation instead of
             # being absorbed into one they never synchronized with
             self._wb_waiting.add(worker_id)
+            next_warn = _time.monotonic() + 30.0
             while True:
                 if self._wb_done > gen:
                     err = self._wb_errs.get(gen)
@@ -801,7 +808,21 @@ class Server:
                     self._wb_gen += 1
                     self._wb_waiting = set()
                     break  # this thread leads the global phase
-                self._wb_cond.wait()
+                self._wb_cond.wait(timeout=5.0)
+                # stall diagnostic: with declared num_workers, a declared-
+                # but-never-created worker hangs the barrier silently —
+                # name the absentees (one thread logs per window)
+                if (_time.monotonic() >= next_warn
+                        and self._wb_gen == gen
+                        and worker_id == min(self._wb_waiting, default=-1)):
+                    missing = sorted(
+                        self._wb_active_ids() - self._wb_waiting)
+                    if missing:
+                        alog(f"[barrier] worker barrier gen {gen} stalled "
+                             f">30s: waiting for worker ids {missing} "
+                             f"(declared num_workers counts workers that "
+                             f"must barrier or finalize)")
+                    next_warn = _time.monotonic() + 30.0
         err = None
         try:
             self.barrier()
@@ -863,11 +884,22 @@ class Server:
 
     def locality_summary(self) -> Dict[str, float]:
         """Aggregate worker op/param locality ratios (reference shutdown
-        summary, coloc_kv_server.h:147-157)."""
+        summary, coloc_kv_server.h:147-157). Device-routed runners count
+        inside the step program; their fused gather+scatter contributes to
+        both the pull and push aggregates."""
         agg: Dict[str, int] = {}
         for w in self._workers.values():
             for k, v in w.stats.items():
                 agg[k] = agg.get(k, 0) + v
+        for src in self._locality_sources:
+            c = src()
+            for kind in ("pull", "push"):
+                for unit in ("ops", "params"):
+                    agg[f"{kind}_{unit}"] = \
+                        agg.get(f"{kind}_{unit}", 0) + c[unit]
+                    agg[f"{kind}_{unit}_local"] = \
+                        agg.get(f"{kind}_{unit}_local", 0) + \
+                        c[f"{unit}_local"]
         out = {}
         for kind in ("pull", "push"):
             for unit in ("ops", "params"):
@@ -1205,7 +1237,12 @@ class Worker:
 
     def barrier(self) -> None:
         """Barrier with every other active worker (all threads, all
-        processes) — reference ColoKVWorker::Barrier."""
+        processes) — reference ColoKVWorker::Barrier.
+
+        Note this is an ALL-WORKER rendezvous, not a per-process barrier
+        (changed from the pre-r3 semantics): with a declared num_workers,
+        every declared worker must eventually barrier or finalize, or the
+        barrier stalls (a periodic warning names the absent ids)."""
         self.server.worker_barrier(self.worker_id)
 
     def begin_setup(self) -> None:

@@ -36,19 +36,38 @@ def rescal_score(s: jnp.ndarray, r: jnp.ndarray,
     return jnp.einsum("...i,...ij,...j->...", s, R, o)
 
 
-def _nll_loss(pos: jnp.ndarray, neg_s: jnp.ndarray,
-              neg_o: jnp.ndarray) -> jnp.ndarray:
+def _nll_loss(pos: jnp.ndarray, neg_s: jnp.ndarray, neg_o: jnp.ndarray,
+              self_adv_temp: float = 0.0) -> jnp.ndarray:
     """Negative-sampling logistic loss: -log sig(pos) - sum log sig(-neg)
     (the reference trains with sigmoid loss over neg_ratio negatives per
-    side, kge.cc train loop :437-531)."""
+    side, kge.cc train loop :437-531).
+
+    self_adv_temp > 0 switches the negative term to SELF-ADVERSARIAL
+    weighting (Sun et al. 2019, RotatE eq. 5): each negative is weighted
+    by softmax(temp * score) with a stopped gradient, so the hardest
+    negatives in the batch dominate the update. This addresses the
+    measured mid-scale failure of uniform negatives (docs/PERF.md
+    "Quality": at 14k entities uniform draws almost never hit the
+    runner-up entities that carry the signal)."""
     pos_l = jax.nn.softplus(-pos)
-    neg_l = jax.nn.softplus(neg_s).sum(-1) + jax.nn.softplus(neg_o).sum(-1)
+    if self_adv_temp > 0.0:
+        ws = jax.nn.softmax(
+            self_adv_temp * jax.lax.stop_gradient(neg_s), axis=-1)
+        wo = jax.nn.softmax(
+            self_adv_temp * jax.lax.stop_gradient(neg_o), axis=-1)
+        neg_l = (ws * jax.nn.softplus(neg_s)).sum(-1) \
+            + (wo * jax.nn.softplus(neg_o)).sum(-1)
+    else:
+        neg_l = jax.nn.softplus(neg_s).sum(-1) \
+            + jax.nn.softplus(neg_o).sum(-1)
     return (pos_l + neg_l).mean()
 
 
-def make_kge_loss(model: str = "complex"):
+def make_kge_loss(model: str = "complex", self_adv_temp: float = 0.0):
     """loss_fn for ops/fused.py. Roles: s, r, o [B, *]; neg [B, N] entity
-    embeddings used to corrupt both the subject and the object side."""
+    embeddings used to corrupt both the subject and the object side.
+    `self_adv_temp` enables self-adversarial negative weighting (see
+    _nll_loss)."""
     score = {"complex": complex_score, "rescal": rescal_score}[model]
 
     def loss_fn(embs, aux):
@@ -57,7 +76,7 @@ def make_kge_loss(model: str = "complex"):
         # corrupt subject and object with the same negative pool
         neg_s = score(neg, r[:, None, :], o[:, None, :])
         neg_o = score(s[:, None, :], r[:, None, :], neg)
-        return _nll_loss(pos, neg_s, neg_o)
+        return _nll_loss(pos, neg_s, neg_o, self_adv_temp)
 
     return loss_fn
 

@@ -11,16 +11,31 @@ the jax.distributed coordinator's key-value store (the scheduler's
 replacement — src/van.cc:40-111 ADD_NODE ↔ key_value_set/get), length-framed
 pickle messages (protocol 5: numpy buffers serialize zero-copy).
 
-Request handling runs on a per-connection receiver thread and takes the
-server lock only around local table/pool operations — never across a nested
-channel call — so two processes pulling from each other cannot deadlock.
+Concurrency model (the reference multiplexes via ZMQ identity frames + N IO
+threads, zmq_van.h:109-112; the analog here is request-id demultiplexing):
+every frame carries a request id, a per-peer reader thread resolves replies
+to their waiting futures, and the serving side dispatches handler calls to
+a small pool and tags each reply with the request's id — so concurrent
+requests to the SAME peer overlap instead of queueing head-of-line behind
+one another (pre-r4 a per-peer lock held across the full round trip
+serialized them). Ordering note: requests from one process to one peer are
+NOT serialized; this matches the existing contract — the write executor in
+parallel/pm.py is multi-threaded, so cross-process writes were already
+unordered, and read-your-writes is enforced above the channel by write
+futures (core/kv.py _WaitEntry), never by socket FIFO.
+
+Request handling takes the server lock only around local table/pool
+operations — never across a nested channel call — so two processes pulling
+from each other cannot deadlock.
 """
 from __future__ import annotations
 
+import itertools
 import pickle
 import socket
 import struct
 import threading
+from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Callable, Dict, Optional
 
 _LEN = struct.Struct("!Q")
@@ -55,22 +70,32 @@ def _recv_msg(sock: socket.socket):
 class DcnChannel:
     """Request/reply channel between the launcher's processes.
 
-    `handler(msg) -> reply` is called for every incoming request on a
-    receiver thread. Outgoing `request(peer, msg)` is synchronous (send +
-    await reply) under a per-peer lock; concurrency across peers is free.
+    `handler(msg) -> reply` is called for every incoming request on the
+    serve pool. Outgoing `request(peer, msg)` is synchronous for the
+    caller (send + await its reply future) but overlaps freely with other
+    in-flight requests to the same or other peers.
     """
 
     def __init__(self, process_id: int, num_processes: int,
-                 handler: Callable):
+                 handler: Callable, serve_threads: int = 4):
         self.pid = process_id
         self.num = num_processes
         self.handler = handler
         self._listener: Optional[socket.socket] = None
         self._peers: Dict[int, socket.socket] = {}
-        self._peer_locks: Dict[int, threading.Lock] = {}
-        # guards _peers/_peer_locks mutation: two threads making first
+        # held only across sendall (frame atomicity), never across a recv
+        self._send_locks: Dict[int, threading.Lock] = {}
+        # guards _peers/_send_locks mutation: two threads making first
         # requests to the same peer must agree on one (socket, lock) pair
         self._resolve_lock = threading.Lock()
+        self._rid = itertools.count(1)
+        self._pending: Dict[int, Future] = {}
+        self._pending_lock = threading.Lock()
+        # peer -> rids awaiting its reply (failed fast on disconnect)
+        self._pending_by_peer: Dict[int, set] = {}
+        self._serve_pool = ThreadPoolExecutor(
+            max_workers=max(1, serve_threads),
+            thread_name_prefix="adapm-dcn-h")
         self._threads = []
         self._stop = threading.Event()
 
@@ -104,8 +129,47 @@ class DcnChannel:
             sock = socket.create_connection((host, int(port)), timeout=60)
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             self._peers[peer] = sock
-            self._peer_locks[peer] = threading.Lock()
+            self._send_locks[peer] = threading.Lock()
+            self._pending_by_peer.setdefault(peer, set())
+            t = threading.Thread(target=self._read_replies,
+                                 args=(peer, sock), daemon=True,
+                                 name=f"adapm-dcn-r{peer}")
+            t.start()
+            self._threads.append(t)
             return sock
+
+    def _read_replies(self, peer: int, sock: socket.socket) -> None:
+        """Demux loop: deliver each tagged reply to its waiting future."""
+        while not self._stop.is_set():
+            try:
+                frame = _recv_msg(sock)
+            except OSError:
+                frame = None
+            if frame is None:
+                break  # disconnect: fail everything still waiting below
+            rid, reply = frame
+            with self._pending_lock:
+                fut = self._pending.pop(rid, None)
+                self._pending_by_peer.get(peer, set()).discard(rid)
+            if fut is not None:
+                fut.set_result(reply)
+        # disconnect. Remove the dead socket FIRST so new requests
+        # re-resolve (a keepalive-restarted peer reconnects; a dead one
+        # fails at connect), THEN fail everything still waiting — any rid
+        # registered against the old socket after this drain is caught by
+        # request()'s post-send liveness check (it observes the socket
+        # gone from _peers).
+        with self._resolve_lock:
+            if self._peers.get(peer) is sock:
+                del self._peers[peer]
+        with self._pending_lock:
+            rids = self._pending_by_peer.pop(peer, set())
+            futs = [self._pending.pop(r) for r in rids
+                    if r in self._pending]
+        for f in futs:
+            if not f.done():
+                f.set_exception(
+                    ConnectionError(f"peer {peer} closed the channel"))
 
     def _accept_loop(self) -> None:
         while not self._stop.is_set():
@@ -120,28 +184,68 @@ class DcnChannel:
             self._threads.append(t)
 
     def _serve(self, conn: socket.socket) -> None:
-        while not self._stop.is_set():
-            msg = _recv_msg(conn)
-            if msg is None:
-                conn.close()
-                return
+        """Per-connection reader: requests fan out to the serve pool and
+        replies return tagged + out-of-order as handlers finish."""
+        send_lock = threading.Lock()
+
+        def run(rid, msg):
             try:
                 reply = self.handler(msg)
             except Exception as e:  # noqa: BLE001 - ship errors to requester
                 reply = ("error", f"{type(e).__name__}: {e}")
-            _send_msg(conn, reply)
+            try:
+                with send_lock:
+                    _send_msg(conn, (rid, reply))
+            except OSError:
+                pass  # requester is gone; its future fails on disconnect
+
+        while not self._stop.is_set():
+            try:
+                frame = _recv_msg(conn)
+            except OSError:
+                frame = None
+            if frame is None:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                return
+            rid, msg = frame
+            self._serve_pool.submit(run, rid, msg)
 
     # -- requests ------------------------------------------------------------
 
     def request(self, peer: int, msg):
-        """Synchronous round-trip to `peer`. Raises on remote error."""
+        """Synchronous round-trip to `peer`. Raises on remote error.
+        Concurrent callers' requests to the same peer are in flight
+        simultaneously (demuxed by request id)."""
         assert peer != self.pid, "use local ops, not a self-request"
         sock = self._resolve(peer)
-        with self._peer_locks[peer]:
-            _send_msg(sock, msg)
-            reply = _recv_msg(sock)
-        if reply is None:
-            raise ConnectionError(f"peer {peer} closed the channel")
+        rid = next(self._rid)
+        fut: Future = Future()
+        with self._pending_lock:
+            self._pending[rid] = fut
+            self._pending_by_peer.setdefault(peer, set()).add(rid)
+        try:
+            with self._send_locks[peer]:
+                _send_msg(sock, (rid, msg))
+        except OSError as e:
+            with self._pending_lock:
+                self._pending.pop(rid, None)
+                self._pending_by_peer.get(peer, set()).discard(rid)
+            raise ConnectionError(f"peer {peer} send failed: {e}") from e
+        # liveness check closing the race with the reader's death: if the
+        # reader drained pendings BEFORE this rid registered, nothing will
+        # ever resolve the future — the reader removes the socket from
+        # _peers before draining, so observing it gone (or replaced) here
+        # means this rid may have been orphaned.
+        if self._peers.get(peer) is not sock:
+            with self._pending_lock:
+                orphaned = self._pending.pop(rid, None)
+                self._pending_by_peer.get(peer, set()).discard(rid)
+            if orphaned is not None and not orphaned.done():
+                raise ConnectionError(f"peer {peer} closed the channel")
+        reply = fut.result()
         if isinstance(reply, tuple) and reply \
                 and isinstance(reply[0], str) and reply[0] == "error":
             raise RuntimeError(f"peer {peer}: {reply[1]}")
@@ -154,9 +258,19 @@ class DcnChannel:
                 self._listener.close()
             except OSError:
                 pass
-        for sock in self._peers.values():
+        # snapshot under the lock: closing a socket wakes its reader
+        # thread, whose death-cleanup removes the peer from _peers —
+        # iterating the live dict here would race that removal
+        with self._resolve_lock:
+            socks = list(self._peers.values())
+            self._peers.clear()
+        for sock in socks:
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
             try:
                 sock.close()
             except OSError:
                 pass
-        self._peers.clear()
+        self._serve_pool.shutdown(wait=False)

@@ -59,6 +59,14 @@ from .dcn import DcnChannel
 MAX_TRIES = 64
 
 
+def executor_widths(opts) -> Tuple[int, int]:
+    """(read-pool, write-pool) worker counts from --sys.dcn_threads
+    (reference --sys.zmq_threads analog): pulls may block on write futures,
+    so writes get a separate, never-starved pool."""
+    nt = max(1, int(opts.dcn_threads))
+    return nt, max(2, nt // 2)
+
+
 def _offsets(lens: np.ndarray) -> np.ndarray:
     offs = np.zeros(len(lens) + 1, dtype=np.int64)
     np.cumsum(lens, out=offs[1:])
@@ -154,16 +162,24 @@ class GlobalPM:
         import threading
         self._delta_mutex = threading.Lock()
 
-        self.chan = DcnChannel(self.pid, self.num_procs, self._handle)
-        self.chan.start()
         # separate pools: pull tasks may block on write futures, so writes
         # must never queue behind blocked pulls. Widths follow
-        # --sys.dcn_threads (reference --sys.zmq_threads analog)
-        nt = max(1, int(server.opts.dcn_threads))
-        self._exec_r = ThreadPoolExecutor(max_workers=nt,
+        # --sys.dcn_threads (reference --sys.zmq_threads analog), which
+        # also sizes the channel's serve pool (handler concurrency)
+        nr, nw = executor_widths(server.opts)
+        self.chan = DcnChannel(self.pid, self.num_procs, self._handle,
+                               serve_threads=nr)
+        self.chan.start()
+        self._exec_r = ThreadPoolExecutor(max_workers=nr,
                                           thread_name_prefix="adapm-pm-r")
-        self._exec_w = ThreadPoolExecutor(max_workers=max(2, nt // 2),
+        self._exec_w = ThreadPoolExecutor(max_workers=nw,
                                           thread_name_prefix="adapm-pm-w")
+        # fan-out pool for _drive's concurrent per-destination round trips.
+        # Dedicated (never _exec_r/_exec_w): its tasks only block on
+        # channel futures and never submit back into it, so it cannot
+        # deadlock even when _drive itself runs on _exec_r
+        self._exec_fan = ThreadPoolExecutor(max_workers=max(2, nr),
+                                            thread_name_prefix="adapm-pm-f")
         control.barrier("pm-up")
 
     # -- partition helpers ---------------------------------------------------
@@ -231,11 +247,26 @@ class GlobalPM:
             # group in the SAME round and then retried next round — a
             # double apply (caught by tests/mp_bisect.py reloc_only)
             dcur = dest[pending].copy()
-            for d in np.unique(dcur):
-                pos = pending[dcur == d]
-                msg = make_msg(keys[pos], pos)
-                reply = serve_local(msg) if d == self.pid \
-                    else self.chan.request(int(d), msg)
+            groups = [(int(d), pending[dcur == d]) for d in np.unique(dcur)]
+            # fan out: all remote destinations' round-trips overlap (the
+            # channel demuxes by request id; pre-r4 each destination's RTT
+            # was paid serially — reference SyncManager channels run in C
+            # parallel threads, coloc_kv_server.h:100-105). Merging stays
+            # on this thread: merge() writes shared buffers.
+            futs = {}
+            n_remote_groups = sum(1 for d, _ in groups if d != self.pid)
+            if n_remote_groups > 1:  # single dest: no pool hop needed
+                for d, pos in groups:
+                    if d != self.pid:
+                        futs[d] = self._exec_fan.submit(
+                            self.chan.request, d, make_msg(keys[pos], pos))
+            for d, pos in groups:
+                if d in futs:
+                    reply = futs[d].result()
+                else:
+                    msg = make_msg(keys[pos], pos)
+                    reply = serve_local(msg) if d == self.pid \
+                        else self.chan.request(d, msg)
                 served = reply[0].astype(bool)
                 owners = merge(reply, pos)
                 self._learn(keys[pos][served], owners[served])
@@ -888,5 +919,6 @@ class GlobalPM:
         control.barrier("pm-pre-down")
         self._exec_r.shutdown(wait=True)
         self._exec_w.shutdown(wait=True)
+        self._exec_fan.shutdown(wait=True)
         control.barrier("pm-down")
         self.chan.shutdown()

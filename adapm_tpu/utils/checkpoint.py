@@ -25,7 +25,11 @@ from typing import Dict
 import numpy as np
 
 
-FORMAT_VERSION = 2
+# v3: pool slot counts are 8-aligned (core/store.py _round8), changing the
+# saved raw-pool geometry — v2 checkpoints written before the alignment
+# change cannot be restored into current pools and are rejected by version,
+# not by an opaque shape assert.
+FORMAT_VERSION = 3
 
 
 def rank_path(path: str, rank: int) -> str:
@@ -98,7 +102,12 @@ def restore_server(server, path: str) -> None:
         assert int(ck["num_procs"]) == 1, (
             "this is one rank shard of a multi-process checkpoint; restore "
             "it under a launcher with the same process count")
-    assert int(ck["format_version"]) == FORMAT_VERSION
+    got = int(ck["format_version"])
+    if got != FORMAT_VERSION:
+        raise ValueError(
+            f"checkpoint format v{got} is incompatible with this build "
+            f"(expects v{FORMAT_VERSION}; v2->v3 changed pool geometry to "
+            f"8-aligned slot counts) — re-export from the writing version")
     assert int(ck["num_keys"]) == server.num_keys, "key count mismatch"
     assert int(ck["num_shards"]) == server.num_shards, "shard mismatch"
     assert (ck["value_lengths"] == server.value_lengths).all(), \

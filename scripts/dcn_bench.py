@@ -67,6 +67,24 @@ def child() -> None:
     t_pull = timed(lambda: pm.request_pull(batch))
     t_push = timed(lambda: pm.request_write(batch, vals, is_set=False))
 
+    # single-peer concurrency: aggregate pull rate with C requests in
+    # flight to the SAME peer (the channel demuxes by request id; pre-r4
+    # a per-peer lock serialized these head-of-line)
+    from concurrent.futures import ThreadPoolExecutor
+
+    def pull_rate_inflight(c: int) -> float:
+        batches = [rng.choice(theirs, BATCH, replace=False)
+                   for _ in range(c)]
+        with ThreadPoolExecutor(c) as ex:
+            list(ex.map(pm.request_pull, batches))  # warm
+            t0 = time.perf_counter()
+            for _ in range(ROUNDS):
+                list(ex.map(pm.request_pull, batches))
+            dt = (time.perf_counter() - t0) / ROUNDS
+        return c * BATCH / dt
+
+    inflight = {c: round(pull_rate_inflight(c)) for c in (1, 2, 4)}
+
     # replicate the batch here: the OWNER rank must hold competing
     # interest first (exclusive intent would relocate instead —
     # sync_manager.h:624-644), so every rank intents its own keys, then
@@ -91,6 +109,7 @@ def child() -> None:
         "pull_MiB_per_s": round(mib / t_pull, 1),
         "push_keys_per_s": round(BATCH / t_push),
         "push_MiB_per_s": round(mib / t_push, 1),
+        "pull_keys_per_s_inflight": inflight,
         "sync_round_ms": round(t_sync * 1e3, 2),
         "sync_keys_per_s": round(BATCH / t_sync),
     }

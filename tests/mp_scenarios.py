@@ -483,8 +483,76 @@ def scenario_heartbeat():
     print(f"MP-OK heartbeat rank={rank}")
 
 
+def scenario_elastic():
+    """The documented recovery loop (docs/failure_handling.md) end to end,
+    driven by the LAUNCHER KEEPALIVE rather than a scripted second launch:
+    train -> checkpoint -> crash with exit code 254 mid-epoch (work after
+    the checkpoint is lost) -> keepalive restarts the ranks with the same
+    env -> restore_server -> values, adapted placement, and the
+    consistency invariant hold (reference dmlc_local.py:15-25 restart
+    contract + this repo's whole-manager checkpoints)."""
+    from adapm_tpu.utils.checkpoint import restore_server, save_server
+    path = sys.argv[2]
+    srv = adapm_tpu.setup(48, 4, opts=SystemOptions(sync_max_per_sec=0))
+    rank = control.process_id()
+    P = control.num_processes()
+    marker = f"{path}.attempt.rank{rank}"
+    first_attempt = not os.path.exists(marker)
+    w = srv.make_worker(0)
+    keys = np.arange(48, dtype=np.int64)
+    if first_attempt:
+        open(marker, "w").write("1")
+        if rank == 0:
+            w.wait(w.set(keys, np.ones((48, 4), np.float32)))
+        srv.barrier()
+        # adapt placement so the restore must carry it: rank 1 takes
+        # ownership of rank-0 keys before the checkpoint
+        moved = owned_by_proc(srv, 0, 4)
+        if rank == 1:
+            w.intent(moved, 0, CLOCK_MAX)
+            srv.wait_sync()
+        srv.barrier()
+        w.wait(w.push(keys, np.ones((48, 4), np.float32)))
+        w.wait_all()
+        save_server(srv, path)  # the per-epoch checkpoint
+        # mid-epoch work after the checkpoint: lost in the crash
+        w.wait(w.push(keys, np.full((48, 4), 7.0, np.float32)))
+        w.wait_all()
+        srv.barrier()  # both ranks reach the crash point
+        # crash: no shutdown, no coordinator teardown — the keepalive
+        # contract restarts this rank with the same rank/env
+        sys.stdout.flush()
+        os._exit(254)
+    # restarted attempt: recover from the checkpoint
+    restore_server(srv, path)
+    base = np.full((48, 4), 1.0 + P, np.float32)  # set(1) + P pushes(+1)
+    v = w.pull_sync(keys)
+    assert np.allclose(v, base), \
+        f"rank {rank}: restored values wrong (lost work resurrected?)\n{v[:2]}"
+    moved = owned_by_proc(srv, 0, 4)
+    if rank == 1:
+        assert (srv.ab.owner[moved] >= 0).all(), "adapted ownership lost"
+    if rank == 0:
+        assert (srv.ab.owner[moved] == REMOTE).all(), "relocation lost"
+    srv.barrier()
+    # the restored manager still satisfies eventual consistency
+    w.wait(w.push(keys, np.ones((48, 4), np.float32)))
+    w.wait(w.push(keys, -np.ones((48, 4), np.float32)))
+    w.wait_all()
+    srv.wait_sync()
+    srv.barrier()
+    srv.wait_sync()
+    srv.barrier()
+    v = w.pull_sync(keys)
+    assert np.allclose(v, base, atol=1e-4), f"rank {rank}: not consistent"
+    srv.shutdown()
+    open(f"{path}.done.rank{rank}", "w").write("1")
+    print(f"MP-OK elastic rank={rank}")
+
+
 SCENARIOS = {
     "pullpush": scenario_pullpush,
+    "elastic": scenario_elastic,
     "intent_locality": scenario_intent_locality,
     "monotonic": scenario_monotonic,
     "eventual": scenario_eventual,

@@ -112,6 +112,23 @@ def test_kge_device_routes_default():
     assert result["mrr"] > 0.12, result
 
 
+def test_kge_freq_negatives_and_self_adversarial():
+    """--neg_sampling freq + --self_adv_temp (the mid-scale levers,
+    VERDICT r3 item 3) train the small synthetic KG at least as well as
+    uniform negatives, on both routing paths."""
+    from adapm_tpu.apps import knowledge_graph_embeddings as kge
+    base = ["--dim", "8", "--neg_ratio", "4", "--synthetic_entities", "60",
+            "--synthetic_relations", "4", "--synthetic_triples", "400",
+            "--epochs", "4", "--batch_size", "32", "--lr", "0.2",
+            "--eval_every", "4", "--eval_triples", "60",
+            "--neg_sampling", "freq", "--self_adv_temp", "1.0"] + FAST
+    result = kge.run_app(kge.build_parser().parse_args(base))
+    assert result["mrr"] > 0.12, result
+    host = kge.run_app(kge.build_parser().parse_args(
+        base + ["--no-device_routes"]))
+    assert host["mrr"] > 0.12, host
+
+
 def test_kge_checkpoint_resume(tmp_path):
     """Checkpoint -> resume (reference kge.cc checkpointing :327-401)."""
     from adapm_tpu.apps import knowledge_graph_embeddings as kge

@@ -66,6 +66,132 @@ def test_launch_local_propagates_failure(tmp_path):
         2, [sys.executable, str(script)], keepalive=False) == 7
 
 
+def _rank_recorder(tmp_path):
+    """A program that records its ADAPM_* env, used to verify the env
+    contract each launch mode assembles."""
+    out = tmp_path / "ranks"
+    out.mkdir(exist_ok=True)
+    script = tmp_path / "prog.py"
+    script.write_text(textwrap.dedent(f"""
+        import os
+        rank = os.environ["ADAPM_PROCESS_ID"]
+        n = os.environ["ADAPM_NUM_PROCESSES"]
+        coord = os.environ["ADAPM_COORDINATOR"]
+        open(r"{out}" + "/" + rank, "w").write(n + " " + coord)
+    """))
+    return out, script
+
+
+def test_launch_ssh_with_path_shim(tmp_path, monkeypatch):
+    """ssh mode (reference tracker/dmlc_ssh.py): a PATH-shim `ssh` records
+    argv and runs the remote command locally, verifying per-host command +
+    env assembly without sshd."""
+    out, script = _rank_recorder(tmp_path)
+    bin_dir = tmp_path / "bin"
+    bin_dir.mkdir()
+    log = tmp_path / "ssh.log"
+    shim = bin_dir / "ssh"
+    # the remote command is the last argv; preceding args are opts + host
+    shim.write_text(textwrap.dedent(f"""\
+        #!/bin/sh
+        printf '%s\\n' "$*" >> {log}
+        for last; do :; done
+        exec sh -c "$last"
+    """))
+    shim.chmod(0o755)
+    monkeypatch.setenv("PATH", f"{bin_dir}:{os.environ['PATH']}")
+    hosts = ["nodeA", "nodeB", "nodeC"]
+    code = launcher.launch_ssh(hosts, [sys.executable, str(script)],
+                               coordinator_port=23456)
+    assert code == 0
+    files = sorted(os.listdir(out))
+    assert files == ["0", "1", "2"]
+    contents = {(out / f).read_text() for f in files}
+    # all ranks agree; coordinator is host 0 at the pinned port
+    assert contents == {"3 nodeA:23456"}
+    lines = log.read_text().splitlines()
+    assert len(lines) == 3
+    # the ssh processes run concurrently, so log lines may interleave in
+    # any order — match each host's line by content, not position
+    for rank, host in enumerate(hosts):
+        ln = next(l for l in lines if f" {host} " in l)
+        assert "StrictHostKeyChecking=no" in ln
+        assert f"ADAPM_PROCESS_ID={rank}" in ln
+        assert f"cd {os.getcwd()}" in ln
+
+
+def test_launch_mpi_with_path_shim(tmp_path, monkeypatch):
+    """mpi mode (reference tracker/dmlc_mpi.py): a PATH-shim `mpirun`
+    records argv and spawns -n local copies with OMPI_COMM_WORLD_RANK set,
+    verifying the MPI-env -> ADAPM-env bootstrap translation."""
+    out, script = _rank_recorder(tmp_path)
+    bin_dir = tmp_path / "bin"
+    bin_dir.mkdir()
+    log = tmp_path / "mpirun.log"
+    shim = bin_dir / "mpirun"
+    shim.write_text(textwrap.dedent(f"""\
+        #!{sys.executable}
+        import os, subprocess, sys
+        args = sys.argv[1:]
+        open(r"{log}", "a").write(" ".join(args) + chr(10))
+        n, cmd, i = 1, [], 0
+        while i < len(args):
+            if args[i] == "-n":
+                n = int(args[i + 1]); i += 2
+            else:
+                cmd.append(args[i]); i += 1
+        procs = []
+        for r in range(n):
+            env = dict(os.environ)
+            env["OMPI_COMM_WORLD_RANK"] = str(r)
+            procs.append(subprocess.Popen(cmd, env=env))
+        code = 0
+        for p in procs:
+            p.wait(); code = code or p.returncode
+        sys.exit(code)
+    """))
+    shim.chmod(0o755)
+    monkeypatch.setenv("PATH", f"{bin_dir}:{os.environ['PATH']}")
+    code = launcher.launch_mpi(2, [sys.executable, str(script)],
+                               coordinator_port=24567)
+    assert code == 0
+    files = sorted(os.listdir(out))
+    assert files == ["0", "1"]
+    contents = {(out / f).read_text() for f in files}
+    assert len(contents) == 1  # same num + coordinator on every rank
+    assert next(iter(contents)).startswith("2 ")
+    assert ":24567" in next(iter(contents))
+    assert "-n 2" in log.read_text()
+
+
+def test_launcher_main_dispatches_all_modes(tmp_path, monkeypatch):
+    """`python -m adapm_tpu.launcher --mode {local,ssh,mpi}` reaches the
+    right launch function with parsed hostfile/port/keepalive flags."""
+    calls = {}
+    monkeypatch.setattr(
+        launcher, "launch_local",
+        lambda n, cmd, keepalive=True: calls.setdefault(
+            "local", (n, cmd, keepalive)) and 0 or 0)
+    monkeypatch.setattr(
+        launcher, "launch_ssh",
+        lambda hosts, cmd, coordinator_port=0: calls.setdefault(
+            "ssh", (hosts, cmd, coordinator_port)) and 0 or 0)
+    monkeypatch.setattr(
+        launcher, "launch_mpi",
+        lambda n, cmd, coordinator_port=0: calls.setdefault(
+            "mpi", (n, cmd, coordinator_port)) and 0 or 0)
+    hostfile = tmp_path / "hosts"
+    hostfile.write_text("a\nb\n")
+    launcher.main(["-n", "4", "--no-keepalive", "--", "prog", "--x"])
+    launcher.main(["--mode", "ssh", "--hostfile", str(hostfile),
+                   "--coordinator-port", "2222", "--", "prog"])
+    launcher.main(["--mode", "mpi", "-n", "3",
+                   "--coordinator-port", "3333", "--", "prog"])
+    assert calls["local"] == (4, ["prog", "--x"], False)
+    assert calls["ssh"] == (["a", "b"], ["prog"], 2222)
+    assert calls["mpi"] == (3, ["prog"], 3333)
+
+
 @pytest.mark.slow
 def test_two_process_distributed_allreduce(tmp_path):
     """Real 2-process rendezvous through the jax.distributed coordinator
@@ -97,7 +223,7 @@ def test_two_process_distributed_allreduce(tmp_path):
         env=launcher.make_env(r, 2, coordinator, env),
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
         for r in range(2)]
-    outs = [p.communicate(timeout=120)[0].decode() for p in procs]
+    outs = [p.communicate(timeout=300)[0].decode() for p in procs]
     for r, (p, o) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"rank {r} failed:\n{o}"
         assert f"RANK {r} OK" in o

@@ -77,6 +77,8 @@ class KgeRun:
         ab = self.srv.ab
         self.ent_class = int(ab.key_class[0])
         self.rel_class = int(ab.key_class[E])
+        self._pool_eval = None       # chunked pool-gather eval program
+        self._pool_eval_chunk = 0
         self.runner = FusedStepRunner(
             self.srv, make_kge_loss(args.model, args.self_adv_temp),
             role_class={"s": self.ent_class, "r": self.rel_class,
@@ -198,7 +200,17 @@ def _side_stats(sc: np.ndarray, true_e: np.ndarray, fi: np.ndarray,
 
 
 def evaluate(run: KgeRun, triples: np.ndarray, batch: int = 64):
-    """Filtered MRR / Hits@{1,10} over `triples`, both-side ranking."""
+    """Filtered MRR / Hits@{1,10} over `triples`, both-side ranking.
+
+    Production path (--eval_chunk > 0, single process): candidate rows are
+    gathered from the POOL in [B, chunk] device tiles and only [B] rank
+    counts return to the host (make_pool_eval_counts) — no dense entity
+    matrix anywhere, which is what makes 4.6M-entity eval feasible
+    (VERDICT r3 item 4). --eval_chunk 0 falls back to the dense-matrix
+    path (also used multi-process, where remote rows are not in the local
+    pool)."""
+    if run.args.eval_chunk > 0 and run.srv.glob is None:
+        return _evaluate_pool(run, triples, batch)
     import jax.numpy as jnp
     ent, _, rel, _ = run.current_model()
     ent_j, rel_j = jnp.asarray(ent), jnp.asarray(rel)
@@ -215,6 +227,79 @@ def evaluate(run: KgeRun, triples: np.ndarray, batch: int = 64):
         fi_s, fe_s = _flt_pairs(list(zip(r.tolist(), o.tolist())), ro_s)
         stats[:4] += _side_stats(so, o, fi_o, fe_o)
         stats[:4] += _side_stats(ss, s, fi_s, fe_s)
+    return stats
+
+
+def _rank_side_stats(greater: np.ndarray) -> np.ndarray:
+    rank = 1 + greater
+    return np.array([(1.0 / rank).sum(), (rank <= 1).sum(),
+                     (rank <= 10).sum(), len(rank)], dtype=np.float64)
+
+
+def _evaluate_pool(run: KgeRun, triples: np.ndarray, batch: int):
+    """Pool-gather eval: device counts + host filter correction."""
+    from ..models.kge import make_pool_eval_counts, score_numpy
+    from ..ops import DeviceRouter
+    srv = run.srv
+    C = min(run.args.eval_chunk, max(run.E, 8))
+    if run._pool_eval is None or run._pool_eval_chunk != C:
+        run._pool_eval = make_pool_eval_counts(
+            run.args.model, run.ent_dim, run.rel_dim, C)
+        run._pool_eval_chunk = C
+    counts_fn = run._pool_eval
+    put = srv.ctx.put_replicated
+    ekeys = run.ekey(np.arange(run.E)).astype(np.int64)
+    nch = -(-run.E // C)
+    pad = np.full(nch * C, ekeys[0], dtype=np.int64)
+    pad[: run.E] = ekeys
+    ent_keys_dev = put(pad.reshape(nch, C))
+    router = DeviceRouter(srv, 0)
+    sr_o, ro_s = run.ds.filters()
+
+    def emb_rows(keys, dim):
+        rows = np.asarray(srv.read_main(keys)).reshape(len(keys), -1)
+        return rows[:, :dim]
+
+    stats = np.zeros(EVAL_LEN, dtype=np.float64)
+    for lo in range(0, len(triples), batch):
+        t = triples[lo:lo + batch]
+        s, r, o = t[:, 0], t[:, 1], t[:, 2]
+        with srv._lock:
+            tables = router.tables()
+            g_o, g_s, true_sc = counts_fn(
+                srv.stores[run.ent_class].main,
+                srv.stores[run.rel_class].main, tables, ent_keys_dev,
+                np.int32(run.E), put(run.ekey(s)), put(run.rkey(r)),
+                put(run.ekey(o)))
+        g_o = np.asarray(g_o).astype(np.int64)
+        g_s = np.asarray(g_s).astype(np.int64)
+        true_sc = np.asarray(true_sc)
+        # filtered-rank correction: subtract the (tiny) per-triple filter
+        # sets' contributions, scored on host from a handful of pool rows
+        for g, fi, fe, true_e, q in (
+                (g_o, *_flt_pairs(list(zip(s.tolist(), r.tolist())), sr_o),
+                 o, "o"),
+                (g_s, *_flt_pairs(list(zip(r.tolist(), o.tolist())), ro_s),
+                 s, "s")):
+            if not len(fi):
+                continue
+            fe_rows = emb_rows(run.ekey(fe), run.ent_dim)
+            r_rows = emb_rows(run.rkey(r[fi]), run.rel_dim)
+            if q == "o":
+                sc_f = score_numpy(run.args.model,
+                                   emb_rows(run.ekey(s[fi]), run.ent_dim),
+                                   r_rows, fe_rows)
+            else:
+                sc_f = score_numpy(run.args.model, fe_rows, r_rows,
+                                   emb_rows(run.ekey(o[fi]), run.ent_dim))
+            contrib = (sc_f > true_sc[fi]) & (fe != true_e[fi])
+            np.subtract.at(g, fi, contrib.astype(np.int64))
+            # host f64 vs device f32 can disagree by an ulp at a tie: a
+            # filter entity the device never counted must not push the
+            # count negative (rank 0 -> infinite MRR)
+            np.maximum(g, 0, out=g)
+        stats[:4] += _rank_side_stats(g_o)
+        stats[:4] += _rank_side_stats(g_s)
     return stats
 
 
@@ -439,6 +524,10 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--adagrad_init", type=float, default=1e-6)
     parser.add_argument("--eval_every", type=int, default=2)
     parser.add_argument("--eval_triples", type=int, default=500)
+    parser.add_argument("--eval_chunk", type=int, default=65536,
+                        help="candidate-chunk size for pool-gather eval "
+                             "(device [B, C] tiles; 0 = dense-matrix "
+                             "fallback)")
     parser.add_argument("--checkpoint_every", type=int, default=0)
     parser.add_argument("--checkpoint_dir", default="/tmp/adapm_kge_ckpt")
     add_common_arguments(parser)

@@ -45,6 +45,13 @@ class SystemOptions:
     sync_pause_ms: float = 0.0
     sync_threshold: float = 0.0      # drop deltas with max-abs below threshold
 
+    # -- collective sync data plane (parallel/collective.py): replica
+    #    delta ship + fresh-value refresh ride device all-to-all exchanges
+    #    at WaitSync/quiesce points instead of per-destination DCN RPC
+    #    (SURVEY's ICI mapping; off = the reference-parity host channel)
+    collective_sync: bool = False
+    collective_bucket: int = 1024    # rows per peer per exchange iteration
+
     # -- ActionTimer (sys.timing.*; reference sync_manager.h:62-158)
     timing_alpha: float = 0.1
     timing_quantile: float = 0.9999
@@ -91,6 +98,10 @@ class SystemOptions:
                        default=0.0)
         g.add_argument("--sys.sync.threshold", dest="sys_sync_threshold",
                        type=float, default=0.0)
+        g.add_argument("--sys.collective_sync", dest="sys_collective_sync",
+                       type=int, default=0)
+        g.add_argument("--sys.collective_bucket",
+                       dest="sys_collective_bucket", type=int, default=1024)
         g.add_argument("--sys.main_over_alloc", dest="sys_main_over_alloc",
                        type=float, default=1.25)
         g.add_argument("--sys.stats.out", dest="sys_stats_out", default=None)
@@ -123,6 +134,8 @@ class SystemOptions:
             sync_max_per_sec=args.sys_sync_max_per_sec,
             sync_pause_ms=args.sys_sync_pause,
             sync_threshold=args.sys_sync_threshold,
+            collective_sync=bool(args.sys_collective_sync),
+            collective_bucket=args.sys_collective_bucket,
             main_over_alloc=args.sys_main_over_alloc,
             stats_out=args.sys_stats_out,
             trace_keys=args.sys_trace_keys,

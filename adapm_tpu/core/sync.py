@@ -217,7 +217,9 @@ class SyncManager:
         if keep:
             srv._sync_replicas(keep, threshold=self.opts.sync_threshold)
             self.stats.keys_synced += len(keep)
-        if keep_x:
+        if keep_x and not self.opts.collective_sync:
+            # collective mode: cross-process deltas accumulate and ship in
+            # the BSP exchange at the next WaitSync/quiesce point
             srv.glob.sync_replicas(keep_x)
             self.stats.keys_synced += len(keep_x)
         if drop or drop_x:
@@ -251,7 +253,26 @@ class SyncManager:
         else:
             self.sync_channel(self._next_channel)
             self._next_channel = (self._next_channel + 1) % self.num_channels
+        if force_intents and all_channels:
+            # the WaitSync shape: in collective mode this is the agreed
+            # point where every process joins the BSP delta exchange
+            self._collective_point()
         self.stats.rounds += 1
+
+    def _collective_point(self) -> None:
+        """Ship all cross-process replica deltas through the collective
+        exchange (parallel/collective.py). Must be reached by every
+        process together; runs (with possibly zero items) whenever
+        collective mode is on."""
+        srv = self.server
+        if srv.glob is None or not self.opts.collective_sync:
+            return
+        with srv._lock:
+            items = [it for c in range(self.num_channels)
+                     for it in self.replicas[c]
+                     if srv.ab.owner[it[0]] < 0]
+        srv.glob.collective_sync(items)
+        self.stats.keys_synced += len(items)
 
     def _throttle(self) -> None:
         """Bound sync frequency (reference sync_manager.h:384-411, 805-814:
@@ -293,9 +314,12 @@ class SyncManager:
             if local:
                 srv._sync_replicas(local)
                 self.stats.keys_synced += len(local)
-            if remote:
+            if remote and not self.opts.collective_sync:
                 srv.glob.sync_replicas(remote)
                 self.stats.keys_synced += len(remote)
+        # collective mode: one BSP exchange covers every cross replica
+        # (joined by all processes, items or not)
+        self._collective_point()
         srv.block()
 
     def report(self) -> str:

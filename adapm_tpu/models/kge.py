@@ -119,3 +119,80 @@ def rescal_eval_scores(ent: jnp.ndarray, rel: jnp.ndarray,
 def make_eval_scores(model: str):
     return {"complex": complex_eval_scores,
             "rescal": rescal_eval_scores}[model]
+
+
+def score_numpy(model: str, s, r, o):
+    """Host-side scoring of a handful of (s, r, o) rows — used for the
+    filtered-rank correction, whose per-batch filter sets are tiny."""
+    import numpy as np
+    s, r, o = (np.asarray(x, dtype=np.float64) for x in (s, r, o))
+    if model == "complex":
+        d = s.shape[-1] // 2
+        sr, si = s[..., :d], s[..., d:]
+        rr, ri = r[..., :d], r[..., d:]
+        orr, oi = o[..., :d], o[..., d:]
+        return (sr * rr * orr + si * rr * oi
+                + sr * ri * oi - si * ri * orr).sum(-1)
+    d = s.shape[-1]
+    R = r.reshape(r.shape[:-1] + (d, d))
+    return np.einsum("...i,...ij,...j->...", s, R, o)
+
+
+def make_pool_eval_counts(model: str, ent_dim: int, rel_dim: int,
+                          chunk: int):
+    """Full-entity eval WITHOUT materializing the entity matrix: candidate
+    rows are gathered straight from the sharded main POOL in [B, chunk]
+    tiles under a lax.scan (VERDICT r3 item 4 — at Wikidata5M scale the
+    old evaluate() shipped ~1.2 GiB of scores to the host per batch of 64
+    and needed a 4.7 GB host entity matrix; reference Evaluator
+    kge.cc:544-775 loops candidates per triple).
+
+    Returns fn(ent_main, rel_main, tables, ent_keys [nch, chunk] (key
+    OOB-padded), nE, skeys [B], rkeys [B], okeys [B]) ->
+    (greater_o [B], greater_s [B], true_sc [B]): for each side, the
+    number of real candidates scoring strictly above the true triple.
+    Filtered-rank correction happens on the host over the (tiny)
+    per-triple filter sets (apps/.. evaluate)."""
+    score = {"complex": complex_score, "rescal": rescal_score}[model]
+    scores_fn = make_eval_scores(model)
+
+    @jax.jit
+    def counts(ent_main, rel_main, tables, ent_keys, nE, skeys, rkeys,
+               okeys):
+        owner, slot, _ = tables
+
+        def ent_rows(keys):
+            return ent_main[owner[keys], slot[keys], :ent_dim]
+
+        se = ent_rows(skeys)
+        oe = ent_rows(okeys)
+        re_ = rel_main[owner[rkeys], slot[rkeys], :rel_dim]
+        true_sc = score(se, re_, oe)  # same triple -> same score each side
+
+        C = ent_keys.shape[1]
+
+        def body(carry, xs):
+            g_o, g_s = carry
+            keys, start = xs
+            rows = ent_rows(keys)                      # [C, d]
+            so, ss = scores_fn(rows, None, se, re_, oe)  # [B, C] each
+            mask = (start + jnp.arange(C)) < nE
+            # exclude the true entity BY KEY, not by score comparison:
+            # the candidate matmul form rounds differently from the
+            # direct true-score form, so the true entity could otherwise
+            # count itself as "greater" by an ulp
+            m_o = mask[None, :] & (keys[None, :] != okeys[:, None])
+            m_s = mask[None, :] & (keys[None, :] != skeys[:, None])
+            g_o = g_o + ((so > true_sc[:, None]) & m_o).sum(
+                axis=1, dtype=jnp.int32)
+            g_s = g_s + ((ss > true_sc[:, None]) & m_s).sum(
+                axis=1, dtype=jnp.int32)
+            return (g_o, g_s), None
+
+        B = skeys.shape[0]
+        z = jnp.zeros(B, jnp.int32)
+        starts = jnp.arange(ent_keys.shape[0]) * C
+        (g_o, g_s), _ = jax.lax.scan(body, (z, z), (ent_keys, starts))
+        return g_o, g_s, true_sc
+
+    return counts

@@ -491,6 +491,16 @@ class DeviceRoutedRunner:
                 self._scalars = {v: out}
         return out
 
+    def _ensure_drain_every(self, role_keys: Dict[str, np.ndarray]) -> None:
+        """Size the locstat drain interval so the int32 params counter
+        stays below 2^30 between drains (computed from the first batch's
+        params-per-step; key shapes are fixed per runner)."""
+        if self._drain_every is None:
+            pps = sum(np.asarray(k).size for k in role_keys.values())
+            if self._neg_shape is not None:
+                pps += int(np.prod(self._neg_shape))
+            self._drain_every = max(1, 2**30 // max(1, pps))
+
     def _drain_locstat(self) -> None:
         """Fold the device accumulator into the host int64 totals and reset
         it. A fetch syncs the device (~60 ms on a relay-attached backend),
@@ -595,11 +605,7 @@ class DeviceRoutedRunner:
             for st, (m, c, d) in zip(srv.stores, pools):
                 st.main, st.cache, st.delta = m, c, d
             self.steps += 1
-            if self._drain_every is None:
-                pps = sum(np.asarray(k).size for k in role_keys.values())
-                if self._neg_shape is not None:
-                    pps += int(np.prod(self._neg_shape))
-                self._drain_every = max(1, 2**30 // max(1, pps))
+            self._ensure_drain_every(role_keys)
             if self.steps % self._drain_every == 0:
                 self._drain_locstat()
         return loss
@@ -660,12 +666,7 @@ class DeviceRoutedRunner:
             for st, (m, c, d) in zip(srv.stores, pools):
                 st.main, st.cache, st.delta = m, c, d
             self.steps += K
-            if self._drain_every is None:
-                pps = sum(np.asarray(k).size
-                          for k in batches[0].values())
-                if self._neg_shape is not None:
-                    pps += int(np.prod(self._neg_shape))
-                self._drain_every = max(1, 2**30 // max(1, pps))
+            self._ensure_drain_every(batches[0])
             if self.steps // self._drain_every != \
                     (self.steps - K) // self._drain_every:
                 self._drain_locstat()

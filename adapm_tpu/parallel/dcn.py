@@ -140,36 +140,40 @@ class DcnChannel:
 
     def _read_replies(self, peer: int, sock: socket.socket) -> None:
         """Demux loop: deliver each tagged reply to its waiting future."""
-        while not self._stop.is_set():
-            try:
-                frame = _recv_msg(sock)
-            except OSError:
-                frame = None
-            if frame is None:
-                break  # disconnect: fail everything still waiting below
-            rid, reply = frame
+        try:
+            while not self._stop.is_set():
+                try:
+                    frame = _recv_msg(sock)
+                except Exception:  # noqa: BLE001 — a corrupt frame must
+                    # still run the death-cleanup below, or every waiter
+                    # hangs forever on an unresolved future
+                    frame = None
+                if frame is None:
+                    return  # disconnect: cleanup in finally
+                rid, reply = frame
+                with self._pending_lock:
+                    fut = self._pending.pop(rid, None)
+                    self._pending_by_peer.get(peer, set()).discard(rid)
+                if fut is not None:
+                    fut.set_result(reply)
+        finally:
+            # disconnect. Remove the dead socket FIRST so new requests
+            # re-resolve (a keepalive-restarted peer reconnects; a dead
+            # one fails at connect), THEN fail everything still waiting —
+            # any rid registered against the old socket after this drain
+            # is caught by request()'s post-send liveness check (it
+            # observes the socket gone from _peers).
+            with self._resolve_lock:
+                if self._peers.get(peer) is sock:
+                    del self._peers[peer]
             with self._pending_lock:
-                fut = self._pending.pop(rid, None)
-                self._pending_by_peer.get(peer, set()).discard(rid)
-            if fut is not None:
-                fut.set_result(reply)
-        # disconnect. Remove the dead socket FIRST so new requests
-        # re-resolve (a keepalive-restarted peer reconnects; a dead one
-        # fails at connect), THEN fail everything still waiting — any rid
-        # registered against the old socket after this drain is caught by
-        # request()'s post-send liveness check (it observes the socket
-        # gone from _peers).
-        with self._resolve_lock:
-            if self._peers.get(peer) is sock:
-                del self._peers[peer]
-        with self._pending_lock:
-            rids = self._pending_by_peer.pop(peer, set())
-            futs = [self._pending.pop(r) for r in rids
-                    if r in self._pending]
-        for f in futs:
-            if not f.done():
-                f.set_exception(
-                    ConnectionError(f"peer {peer} closed the channel"))
+                rids = self._pending_by_peer.pop(peer, set())
+                futs = [self._pending.pop(r) for r in rids
+                        if r in self._pending]
+            for f in futs:
+                if not f.done():
+                    f.set_exception(
+                        ConnectionError(f"peer {peer} closed the channel"))
 
     def _accept_loop(self) -> None:
         while not self._stop.is_set():

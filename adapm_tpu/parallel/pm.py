@@ -180,6 +180,13 @@ class GlobalPM:
         # deadlock even when _drive itself runs on _exec_r
         self._exec_fan = ThreadPoolExecutor(max_workers=max(2, nr),
                                             thread_name_prefix="adapm-pm-f")
+        # BSP collective sync engine (--sys.collective_sync): replica
+        # delta/fresh rows ride device all-to-all at WaitSync/quiesce
+        # points instead of DCN RPC (parallel/collective.py)
+        self.coll = None
+        if server.opts.collective_sync:
+            from .collective import CollectiveSync
+            self.coll = CollectiveSync(self, server.opts.collective_bucket)
         control.barrier("pm-up")
 
     # -- partition helpers ---------------------------------------------------
@@ -723,7 +730,9 @@ class GlobalPM:
         with self._delta_mutex:
             self._sync_replicas_locked(items)
 
-    def _sync_replicas_locked(self, items: List[Tuple[int, int]]) -> None:
+    def _extract_deltas(self, items: List[Tuple[int, int]]):
+        """Snapshot live replica items + their pending delta rows; returns
+        None when nothing is live, else the state _install_fresh needs."""
         srv = self.server
         karr = np.fromiter((k for k, _ in items), np.int64, len(items))
         sarr = np.fromiter((s for _, s in items), np.int32, len(items))
@@ -734,7 +743,7 @@ class GlobalPM:
             ok = srv.ab.cache_slot[sarr, karr] >= 0
             karr, sarr = karr[ok], sarr[ok]
             if len(karr) == 0:
-                return
+                return None
             lens = srv.value_lengths[karr]
             offs = _offsets(lens)
             shipped = np.empty(offs[-1], dtype=np.float32)
@@ -744,7 +753,13 @@ class GlobalPM:
                                                  cs_all[pos])
                 class_rows[cid] = (pos, rows)
                 _fill_flat(shipped, offs, lens, pos, rows.ravel())
-        fresh = self._request_sync(karr, shipped)
+        return karr, sarr, cs_all, class_rows, lens, offs, shipped
+
+    def _install_fresh(self, karr, sarr, cs_all, class_rows, lens, offs,
+                       fresh) -> None:
+        """Install owner-fresh values as the new replica bases, subtracting
+        exactly the shipped deltas (refresh_after_sync)."""
+        srv = self.server
         with srv._lock:
             ab = srv.ab
             for cid, (pos, rows) in class_rows.items():
@@ -760,7 +775,36 @@ class GlobalPM:
                     _select_flat(fresh, offs, lens,
                                  pos[live]).reshape(-1, L),
                     rows[live])
+
+    def _sync_replicas_locked(self, items: List[Tuple[int, int]]) -> None:
+        ext = self._extract_deltas(items)
+        if ext is None:
+            return
+        karr, sarr, cs_all, class_rows, lens, offs, shipped = ext
+        fresh = self._request_sync(karr, shipped)
+        self._install_fresh(karr, sarr, cs_all, class_rows, lens, offs,
+                            fresh)
         self.stats["keys_synced_out"] += len(items)
+
+    def collective_sync(self, items: List[Tuple[int, int]]) -> None:
+        """BSP replica refresh over device collectives
+        (parallel/collective.py): same contract as sync_replicas, but
+        EVERY process must call this together (the WaitSync/quiesce
+        protocol) — `items` may be empty and the process still joins each
+        exchange. Enabled by --sys.collective_sync."""
+        assert self.coll is not None, "--sys.collective_sync is off"
+        with self._delta_mutex:
+            ext = self._extract_deltas(items)
+            if ext is None:
+                empty = np.empty(0, dtype=np.int64)
+                self.coll.request_sync(empty, np.empty(0, np.float32),
+                                       empty)
+                return
+            karr, sarr, cs_all, class_rows, lens, offs, shipped = ext
+            fresh = self.coll.request_sync(karr, shipped, lens)
+            self._install_fresh(karr, sarr, cs_all, class_rows, lens,
+                                offs, fresh)
+            self.stats["keys_synced_out"] += len(karr)
 
     def drop_replicas(self, items: List[Tuple[int, int]]) -> None:
         """Drop local replicas of remote-owned keys: ship the final delta

@@ -163,12 +163,17 @@ def scenario_eventual():
     set under replication; after the quiesce protocol all ranks read the
     exact base everywhere (reference test_many_key_operations phase 3).
     argv[2] selects --sys.techniques (the reference's run_tests.sh
-    variants: all / replication_only / relocation_only)."""
+    variants: all / replication_only / relocation_only); argv[3] == "coll"
+    runs the BSP collective sync data plane (--sys.collective_sync,
+    parallel/collective.py) with a small bucket so the exchange loop runs
+    several padded iterations."""
     from adapm_tpu.base import MgmtTechniques
     tech = MgmtTechniques(sys.argv[2]) if len(sys.argv) > 2 \
         else MgmtTechniques.ALL
+    coll = len(sys.argv) > 3 and sys.argv[3] == "coll"
     srv = adapm_tpu.setup(48, 4, opts=SystemOptions(
-        sync_max_per_sec=0, techniques=tech))
+        sync_max_per_sec=0, techniques=tech,
+        collective_sync=coll, collective_bucket=16))
     rank = control.process_id()
     w = srv.make_worker(0)
     keys = np.arange(48, dtype=np.int64)

@@ -112,6 +112,26 @@ def test_kge_device_routes_default():
     assert result["mrr"] > 0.12, result
 
 
+def test_kge_pool_eval_matches_dense():
+    """The chunked pool-gather eval (--eval_chunk > 0; VERDICT r3 item 4)
+    must produce the same filtered-rank statistics as the dense-matrix
+    path, including the scan padding tail (chunk does not divide E)."""
+    from adapm_tpu.apps import knowledge_graph_embeddings as kge
+    from adapm_tpu.io import kge as kgeio
+    args = kge.build_parser().parse_args(
+        ["--dim", "8", "--synthetic_entities", "60",
+         "--synthetic_relations", "4", "--synthetic_triples", "300",
+         "--eval_chunk", "16"] + FAST)
+    ds = kgeio.generate_synthetic(60, 4, 300, seed=1)
+    run = kge.KgeRun(args, ds)
+    run.init_model()  # random model: rank equivalence needs no training
+    pool = kge.evaluate(run, ds.test[:60])
+    args.eval_chunk = 0
+    dense = kge.evaluate(run, ds.test[:60])
+    assert np.allclose(pool, dense), (pool[:4], dense[:4])
+    run.srv.shutdown()
+
+
 def test_kge_freq_negatives_and_self_adversarial():
     """--neg_sampling freq + --self_adv_temp (the mid-scale levers,
     VERDICT r3 item 3) train the small synthetic KG at least as well as

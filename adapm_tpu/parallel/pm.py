@@ -151,6 +151,11 @@ class GlobalPM:
                       "intents_in": 0, "relocations_out": 0,
                       "relocations_in": 0, "replicas_granted": 0,
                       "syncs_in": 0, "keys_synced_out": 0}
+        # hop histogram: keys SERVED at try 1 / 2 / 3+ of the redirect-
+        # retry driver (the reference prints a refresh hop histogram,
+        # sync_manager.h:504-519; hops==1 means the location cache or
+        # manager pointed straight at the owner)
+        self.hops = np.zeros(3, dtype=np.int64)
 
         # Serializes "delta in flight" windows: a cross-process sync round
         # holds this across extract -> ship -> refresh; anything that
@@ -276,6 +281,7 @@ class GlobalPM:
                         else self.chan.request(d, msg)
                 served = reply[0].astype(bool)
                 owners = merge(reply, pos)
+                self.hops[min(tries, 3) - 1] += int(served.sum())
                 self._learn(keys[pos][served], owners[served])
                 uns = pos[~served]
                 if len(uns):
@@ -943,12 +949,20 @@ class GlobalPM:
 
     def report(self) -> str:
         s = self.stats
-        return (f"pm: pulls_in={s['pulls_in']} pushes_in={s['pushes_in']} "
-                f"redirects={s['redirects']} intents_in={s['intents_in']} "
-                f"reloc_out={s['relocations_out']} "
-                f"reloc_in={s['relocations_in']} "
-                f"rep_granted={s['replicas_granted']} "
-                f"synced_out={s['keys_synced_out']}")
+        h = self.hops
+        out = (f"pm: pulls_in={s['pulls_in']} pushes_in={s['pushes_in']} "
+               f"redirects={s['redirects']} intents_in={s['intents_in']} "
+               f"reloc_out={s['relocations_out']} "
+               f"reloc_in={s['relocations_in']} "
+               f"rep_granted={s['replicas_granted']} "
+               f"synced_out={s['keys_synced_out']} "
+               f"hops(1/2/3+)={h[0]}/{h[1]}/{h[2]}")
+        if self.coll is not None:
+            c = self.coll.stats
+            out += (f" | coll: rounds={c['rounds']} "
+                    f"iters={c['iterations']} rows_out={c['rows_out']} "
+                    f"rows_in={c['rows_in']}")
+        return out
 
     def shutdown(self) -> None:
         # Three-step leave-together protocol:

@@ -6,8 +6,15 @@ tests/test_locality_api.cc) launched by tracker/dmlc_local.py.
 
 Usage: ADAPM_* env set by the launcher; argv[1] = scenario name.
 """
+import faulthandler
 import os
 import sys
+
+# hung-scenario diagnostics: dump all thread stacks and exit BEFORE the
+# harness's subprocess timeout, so the test failure carries the stacks
+# instead of a bare TimeoutExpired (run_mp sets the budget)
+faulthandler.dump_traceback_later(
+    int(os.environ.get("ADAPM_FAULT_T", "280")), exit=True)
 
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ["ADAPM_PLATFORM"] = "cpu"

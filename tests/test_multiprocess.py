@@ -28,6 +28,8 @@ def run_mp(n, scenario, devices=2, args=(), timeout=300):
     env["JAX_PLATFORMS"] = "cpu"
     env["ADAPM_PLATFORM"] = "cpu"
     env["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={devices}"
+    # a hung scenario dumps its thread stacks + exits before our timeout
+    env["ADAPM_FAULT_T"] = str(max(timeout - 20, 30))
     coordinator = f"localhost:{launcher.free_port()}"
     procs = [subprocess.Popen(
         [sys.executable, SCENARIOS, scenario, *map(str, args)],
@@ -87,14 +89,14 @@ def test_mp_eventual_consistency_collective(tech):
     replica deltas and fresh values ride device all-to-all exchanges at
     the WaitSync points instead of DCN RPC; bucket 16 forces several
     padded exchange iterations."""
-    run_mp(2, "eventual", args=(tech, "coll"))
+    run_mp(2, "eventual", args=(tech, "coll"), timeout=420)
 
 
 @pytest.mark.slow
 def test_mp_eventual_collective_three_procs():
     """Collective sync with P=3: routing by owner, per-destination
     buckets, and the global-backlog loop all span more than one peer."""
-    run_mp(3, "eventual", args=("all", "coll"), devices=1)
+    run_mp(3, "eventual", args=("all", "coll"), devices=1, timeout=420)
 
 
 @pytest.mark.slow

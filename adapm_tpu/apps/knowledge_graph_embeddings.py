@@ -37,7 +37,11 @@ from .common import (KeyMapper, RuntimeGuard, add_common_arguments,
                      global_worker_slices, make_server, wrap_batches,
                      worker0_init)
 
-EVAL_LEN = 8  # [mrr_sum, h1, h10, count, ...pad] (reference eval_key len 20)
+# eval stats layout: [0:4] object side (mrr_sum, h1, h10, count),
+# [4:8] subject side — separated because the generators/datasets can have
+# asymmetric sides (the lowrank synthetic's subject is information-free,
+# docs/PERF.md); reported combined plus per-side (reference eval_key len 20)
+EVAL_LEN = 8
 
 
 class KgeRun:
@@ -226,7 +230,7 @@ def evaluate(run: KgeRun, triples: np.ndarray, batch: int = 64):
         fi_o, fe_o = _flt_pairs(list(zip(s.tolist(), r.tolist())), sr_o)
         fi_s, fe_s = _flt_pairs(list(zip(r.tolist(), o.tolist())), ro_s)
         stats[:4] += _side_stats(so, o, fi_o, fe_o)
-        stats[:4] += _side_stats(ss, s, fi_s, fe_s)
+        stats[4:] += _side_stats(ss, s, fi_s, fe_s)
     return stats
 
 
@@ -299,7 +303,7 @@ def _evaluate_pool(run: KgeRun, triples: np.ndarray, batch: int):
             # count negative (rank 0 -> infinite MRR)
             np.maximum(g, 0, out=g)
         stats[:4] += _rank_side_stats(g_o)
-        stats[:4] += _rank_side_stats(g_s)
+        stats[4:] += _rank_side_stats(g_s)
     return stats
 
 
@@ -446,11 +450,15 @@ def run_app(args) -> dict:
             stats = evaluate(run, ev)
             agg = run.allreduce(run.eval_key_l, stats)
             run.reset_key(run.eval_key_l, EVAL_LEN)
-            cnt = max(float(agg[3]), 1.0)
-            result.update(mrr=float(agg[0]) / cnt,
-                          hits1=float(agg[1]) / cnt,
-                          hits10=float(agg[2]) / cnt)
+            cnt = max(float(agg[3]) + float(agg[7]), 1.0)
+            result.update(
+                mrr=(float(agg[0]) + float(agg[4])) / cnt,
+                hits1=(float(agg[1]) + float(agg[5])) / cnt,
+                hits10=(float(agg[2]) + float(agg[6])) / cnt,
+                mrr_o=float(agg[0]) / max(float(agg[3]), 1.0),
+                mrr_s=float(agg[4]) / max(float(agg[7]), 1.0))
             alog(f"[kge] epoch {epoch}: filtered MRR={result['mrr']:.4f} "
+                 f"(o={result['mrr_o']:.4f} s={result['mrr_s']:.4f}) "
                  f"Hits@1={result['hits1']:.4f} "
                  f"Hits@10={result['hits10']:.4f}")
         if args.checkpoint_every and \
@@ -471,10 +479,14 @@ def run_app(args) -> dict:
         stats = evaluate(run, tv)
         agg = run.allreduce(run.eval_key_l, stats)
         run.reset_key(run.eval_key_l, EVAL_LEN)
-        cnt = max(float(agg[3]), 1.0)
-        result.update(test_mrr=float(agg[0]) / cnt,
-                      test_hits10=float(agg[2]) / cnt)
+        cnt = max(float(agg[3]) + float(agg[7]), 1.0)
+        result.update(
+            test_mrr=(float(agg[0]) + float(agg[4])) / cnt,
+            test_hits10=(float(agg[2]) + float(agg[6])) / cnt,
+            test_mrr_o=float(agg[0]) / max(float(agg[3]), 1.0),
+            test_mrr_s=float(agg[4]) / max(float(agg[7]), 1.0))
         alog(f"[kge] TEST filtered MRR={result['test_mrr']:.4f} "
+             f"(o={result['test_mrr_o']:.4f} s={result['test_mrr_s']:.4f}) "
              f"Hits@10={result['test_hits10']:.4f}")
     alog("[kge]", srv.sync.report())
     srv.shutdown()

@@ -126,3 +126,18 @@ def test_dcn_threads_sizes_pm_executors():
     assert executor_widths(opts) == (3, 2)
     wide = SystemOptions.from_args(p.parse_args(["--sys.dcn_threads", "8"]))
     assert executor_widths(wide) == (8, 4)
+
+
+def test_collective_sync_knobs():
+    """--sys.collective_sync / --sys.collective_bucket parse into the
+    options GlobalPM consults when choosing the sync data plane."""
+    import argparse
+
+    from adapm_tpu.config import SystemOptions
+    p = argparse.ArgumentParser()
+    SystemOptions.add_arguments(p)
+    off = SystemOptions.from_args(p.parse_args([]))
+    assert off.collective_sync is False and off.collective_bucket == 1024
+    on = SystemOptions.from_args(p.parse_args(
+        ["--sys.collective_sync", "1", "--sys.collective_bucket", "256"]))
+    assert on.collective_sync is True and on.collective_bucket == 256

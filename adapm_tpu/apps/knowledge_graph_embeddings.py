@@ -319,7 +319,8 @@ def run_app(args) -> dict:
             num_relations=args.synthetic_relations,
             n_train=args.synthetic_triples, seed=args.seed)
         alog(f"[kge] lowrank synthetic: generating-model filtered "
-             f"MRR ceiling = {truth_mrr:.4f}")
+             f"MRR ceiling = {truth_mrr:.4f} (o={ds.truth_mrr_o:.4f} "
+             f"s={ds.truth_mrr_s:.4f})")
     else:
         ds = kgeio.generate_synthetic(
             num_entities=args.synthetic_entities,
@@ -387,6 +388,8 @@ def run_app(args) -> dict:
     result = {}
     if truth_mrr is not None:
         result["truth_mrr"] = truth_mrr
+        result["truth_mrr_o"] = ds.truth_mrr_o
+        result["truth_mrr_s"] = ds.truth_mrr_s
 
     for epoch in range(args.epochs):
         # losses stay device scalars until epoch end: a float() per step
@@ -408,9 +411,36 @@ def run_app(args) -> dict:
                 if not args.device_routes:
                     handles[bi] = w.prepare_sample(B * N, fut, fut + 1)
 
-            for bi in range(min(args.lookahead, len(batches))):
+            K = max(1, args.scan_steps) if args.device_routes else 1
+            for bi in range(min(max(args.lookahead, K), len(batches))):
                 prepare(bi, ahead=bi)
-            for bi, idx in enumerate(batches):
+            if K > 1:
+                # K-step scan windows (runner.run_scan): ONE dispatch
+                # trains K batches; intents run a window ahead and the K
+                # planner rounds + clock ticks execute while the device
+                # works through the window (VERDICT r3 item 2). The tail
+                # window short of K batches falls back to per-step.
+                look = max(args.lookahead, K)
+                for lo in range(0, len(batches) - len(batches) % K, K):
+                    for bi in range(lo + look,
+                                    min(lo + look + K, len(batches))):
+                        prepare(bi, ahead=bi - lo)
+                    window = [train[batches[lo + j]] for j in range(K)]
+                    roles = [{"s": run.ekey(t[:, 0]),
+                              "r": run.rkey(t[:, 1]),
+                              "o": run.ekey(t[:, 2])} for t in window]
+                    epoch_losses.append(
+                        device_runner(w.shard).run_scan(
+                            roles, None, args.lr))
+                    for _ in range(K * args.sync_rounds_per_step):
+                        srv.sync.run_round()
+                    for _ in range(K):
+                        w.advance_clock()
+                tail_start = len(batches) - len(batches) % K
+            else:
+                tail_start = 0
+            for bi in range(tail_start, len(batches)):
+                idx = batches[bi]
                 if bi + args.lookahead < len(batches):
                     prepare(bi + args.lookahead, ahead=args.lookahead)
                 t = train[idx]
@@ -430,8 +460,10 @@ def run_app(args) -> dict:
                 w.advance_clock()
         srv.quiesce()
 
-        epoch_loss = float(np.sum([float(l) for l in epoch_losses]))
-        nbatches = len(epoch_losses)
+        # scan windows contribute [K] loss vectors, per-step path scalars
+        epoch_loss = float(np.sum([np.asarray(l).sum()
+                                   for l in epoch_losses]))
+        nbatches = int(np.sum([np.asarray(l).size for l in epoch_losses]))
         # loss aggregation through the PS loss key (ps_allreduce idiom)
         total = run.allreduce(run.loss_key_l,
                               np.array([epoch_loss / max(nbatches, 1)]))
@@ -513,6 +545,10 @@ def build_parser() -> argparse.ArgumentParser:
                              "model (learnable by construction)")
     parser.add_argument("--lookahead", type=int, default=4,
                         help="intent/sample batches ahead (kge.cc :1059)")
+    parser.add_argument("--scan_steps", type=int, default=1,
+                        help="K>1: train K batches per device dispatch "
+                             "(lax.scan window, runner.run_scan; device "
+                             "routing only — amortizes dispatch overhead)")
     parser.add_argument("--device_routes",
                         action=argparse.BooleanOptionalAction, default=True,
                         help="device-routed fused step + on-device "

@@ -149,6 +149,45 @@ def test_kge_freq_negatives_and_self_adversarial():
     assert host["mrr"] > 0.12, host
 
 
+def test_kge_scan_steps_trains():
+    """--scan_steps K trains K batches per dispatch (lax.scan window)
+    and reaches the same quality bar as the per-step path, including a
+    non-K-divisible batch-count tail."""
+    from adapm_tpu.apps import knowledge_graph_embeddings as kge
+    args = kge.build_parser().parse_args(
+        ["--dim", "8", "--neg_ratio", "2", "--synthetic_entities", "60",
+         "--synthetic_relations", "4", "--synthetic_triples", "400",
+         "--epochs", "4", "--batch_size", "32", "--lr", "0.2",
+         "--eval_every", "4", "--eval_triples", "60",
+         "--scan_steps", "4"] + FAST)
+    result = kge.run_app(args)
+    assert result["mrr"] > 0.12, result
+
+
+@pytest.mark.slow
+def test_kge_midscale_levers_beat_uniform():
+    """Mid-scale lowrank (5k entities, 60k triples — the scale where
+    uniform negatives saturate, docs/PERF.md 'Quality'): frequency-based
+    negatives + self-adversarial weighting must clearly beat uniform at
+    an identical budget (VERDICT r3 item 3). Measured at this config:
+    uniform test-MRR 0.022, freq+selfadv 0.044, ceiling 0.34 (o=0.49)."""
+    from adapm_tpu.apps import knowledge_graph_embeddings as kge
+    base = ["--dim", "32", "--neg_ratio", "32",
+            "--synthetic_entities", "5000", "--synthetic_relations", "16",
+            "--synthetic_triples", "60000", "--synthetic_mode", "lowrank",
+            "--epochs", "25", "--batch_size", "1024", "--lr", "0.3",
+            "--eval_every", "25", "--eval_triples", "500",
+            "--seed", "0"] + FAST
+    uni = kge.run_app(kge.build_parser().parse_args(base))
+    adv = kge.run_app(kge.build_parser().parse_args(
+        base + ["--neg_sampling", "freq", "--self_adv_temp", "1.0"]))
+    assert adv["test_mrr"] > 1.5 * uni["test_mrr"], (adv, uni)
+    assert adv["test_mrr"] > 0.033, adv
+    # the learnable side carries the signal: object-side MRR must beat
+    # uniform's too (the subject side is near-information-free here)
+    assert adv["test_mrr_o"] > uni["test_mrr_o"], (adv, uni)
+
+
 def test_kge_checkpoint_resume(tmp_path):
     """Checkpoint -> resume (reference kge.cc checkpointing :327-401)."""
     from adapm_tpu.apps import knowledge_graph_embeddings as kge

@@ -51,6 +51,15 @@ class SystemOptions:
     #    (SURVEY's ICI mapping; off = the reference-parity host channel)
     collective_sync: bool = False
     collective_bucket: int = 1024    # rows per peer per exchange iteration
+    # bounded staleness for collective mode: every process joins a BSP
+    # exchange each time its workers' min clock crosses a multiple of K
+    # (checked in run_round), so a replica observes remote pushes within
+    # K clocks — the reference's continuously-running sync loop analog
+    # (sync_manager.h:452-520). 0 = exchanges only at WaitSync/quiesce.
+    # Requires clock-advancing training loops on EVERY process (the
+    # co-located worker+server model); skewed per-process batch counts
+    # are absorbed by the quiesce-time flag loop.
+    collective_cadence: int = 0
 
     # -- ActionTimer (sys.timing.*; reference sync_manager.h:62-158)
     timing_alpha: float = 0.1
@@ -102,6 +111,8 @@ class SystemOptions:
                        type=int, default=0)
         g.add_argument("--sys.collective_bucket",
                        dest="sys_collective_bucket", type=int, default=1024)
+        g.add_argument("--sys.collective_cadence",
+                       dest="sys_collective_cadence", type=int, default=0)
         g.add_argument("--sys.main_over_alloc", dest="sys_main_over_alloc",
                        type=float, default=1.25)
         g.add_argument("--sys.stats.out", dest="sys_stats_out", default=None)
@@ -136,6 +147,7 @@ class SystemOptions:
             sync_threshold=args.sys_sync_threshold,
             collective_sync=bool(args.sys_collective_sync),
             collective_bucket=args.sys_collective_bucket,
+            collective_cadence=args.sys_collective_cadence,
             main_over_alloc=args.sys_main_over_alloc,
             stats_out=args.sys_stats_out,
             trace_keys=args.sys_trace_keys,

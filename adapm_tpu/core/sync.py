@@ -75,6 +75,14 @@ class SyncManager:
         self.stats = SyncStats()
         self._next_channel = 0
         self._last_round_t = 0.0
+        # collective cadence state (--sys.collective_cadence K): local
+        # joins of the BSP exchange must be serialized (two local threads
+        # entering the all-to-all concurrently would corrupt the global
+        # exchange sequence); _cad_joined counts the clock boundaries
+        # already serviced since the last global sync point
+        import threading
+        self._coll_lock = threading.Lock()
+        self._cad_joined = 0
 
     # ------------------------------------------------------------------
     # intent registration + replicate-vs-relocate decision
@@ -257,22 +265,82 @@ class SyncManager:
             # the WaitSync shape: in collective mode this is the agreed
             # point where every process joins the BSP delta exchange
             self._collective_point()
+        else:
+            self._maybe_cadence()
         self.stats.rounds += 1
+
+    def _collective_active(self) -> bool:
+        srv = self.server
+        return srv.glob is not None and self.opts.collective_sync
+
+    def _collective_exchange(self, quiescing: bool) -> bool:
+        """One BSP exchange of every cross-process replica delta (caller
+        holds _coll_lock). Returns True iff all processes entered it
+        quiescing."""
+        srv = self.server
+        with srv._lock:
+            items = [it for c in range(self.num_channels)
+                     for it in self.replicas[c]
+                     if srv.ab.owner[it[0]] < 0]
+        all_q = srv.glob.collective_sync(items, quiescing=quiescing)
+        self.stats.keys_synced += len(items)
+        return all_q
+
+    def _min_active_clock(self):
+        """Min clock over this process's registered, unfinished workers;
+        None when no worker is active (cadence then never triggers)."""
+        from ..base import WORKER_FINISHED
+        srv = self.server
+        clocks = [int(srv._clocks[wid]) for wid in list(srv._workers)
+                  if srv._clocks[wid] != WORKER_FINISHED]
+        return min(clocks) if clocks else None
+
+    def _maybe_cadence(self) -> None:
+        """--sys.collective_cadence K: join one BSP exchange per K-clock
+        boundary this process's workers have crossed. Every process runs
+        the same check in its run_round, so exchanges pair up globally in
+        boundary order; a process that crosses fewer boundaries before
+        its next WaitSync/quiesce is absorbed there by the flag loop
+        (_collective_point). Bounded staleness: a replica observes any
+        remote push within K clocks of the slowest process (plus one
+        run_round), vs unbounded between wait points with cadence off."""
+        K = self.opts.collective_cadence
+        if K <= 0 or not self._collective_active():
+            return
+        while True:
+            mc = self._min_active_clock()
+            if mc is None or mc < (self._cad_joined + 1) * K:
+                return
+            with self._coll_lock:
+                # re-check: another local thread may have serviced it (or
+                # the last worker may have finalized mid-check)
+                mc = self._min_active_clock()
+                if mc is None or mc < (self._cad_joined + 1) * K:
+                    continue
+                self._cad_joined += 1
+                self._collective_exchange(quiescing=False)
 
     def _collective_point(self) -> None:
         """Ship all cross-process replica deltas through the collective
         exchange (parallel/collective.py). Must be reached by every
         process together; runs (with possibly zero items) whenever
-        collective mode is on."""
-        srv = self.server
-        if srv.glob is None or not self.opts.collective_sync:
+        collective mode is on. With a cadence configured this is a FLAG
+        LOOP: the process keeps joining exchanges (quiescing=True) until
+        every peer is also at its wait point — absorbing peers that cross
+        more cadence boundaries than we did (skewed batch counts)."""
+        if not self._collective_active():
             return
-        with srv._lock:
-            items = [it for c in range(self.num_channels)
-                     for it in self.replicas[c]
-                     if srv.ab.owner[it[0]] < 0]
-        srv.glob.collective_sync(items)
-        self.stats.keys_synced += len(items)
+        with self._coll_lock:
+            while True:
+                all_q = self._collective_exchange(quiescing=True)
+                if all_q or self.opts.collective_cadence <= 0:
+                    break
+            # quiesce is a global sync point: re-base the cadence so all
+            # processes agree that past boundaries need no exchange
+            K = self.opts.collective_cadence
+            if K > 0:
+                mc = self._min_active_clock()
+                self._cad_joined = 0 if mc is None else mc // K
 
     def _throttle(self) -> None:
         """Bound sync frequency (reference sync_manager.h:384-411, 805-814:

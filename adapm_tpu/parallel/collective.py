@@ -39,6 +39,44 @@ NO_KEY = np.int64(-1)  # bucket padding
 MAX_ROUNDS = 64        # convergence bound, mirrors pm.MAX_TRIES
 
 
+class _JoinWatchdog:
+    """Logs while a process sits at a collective join point.
+
+    The collective contract is stricter than the reference's WaitSync —
+    EVERY process must reach the exchange together — so a unilateral
+    Server.wait_sync() (e.g. the bindings' per-worker wait_sync on one
+    rank only) blocks forever here. Without this, the only symptom is a
+    bare hang (faulthandler at best); with it, the stuck rank says what
+    it is waiting for every `warn_after` seconds."""
+
+    def __init__(self, pid: int, what: str, warn_after: float = 20.0):
+        import threading
+        self._msg = (f"pm{pid}: collective sync point ({what}): still "
+                     f"waiting for peers after %.0fs — with "
+                     f"--sys.collective_sync EVERY process must reach "
+                     f"WaitSync/quiesce together; an asymmetric "
+                     f"wait_sync hangs here")
+        self._warn_after = warn_after
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="adapm-coll-watchdog")
+
+    def _run(self):
+        from ..utils.log import alog
+        import time as _time
+        t0 = _time.monotonic()
+        while not self._stop.wait(self._warn_after):
+            alog(self._msg % (_time.monotonic() - t0))
+
+    def __enter__(self):
+        self._thread.start()
+        return self
+
+    def __exit__(self, *exc):
+        self._stop.set()
+        return False
+
+
 class CollectiveSync:
     """The exchange engine: one device per process, jitted all-to-all
     programs cached per (bucket, row_length) pair."""
@@ -58,6 +96,7 @@ class CollectiveSync:
         self._sharding = NamedSharding(self._mesh, PartitionSpec("p"))
         self._mine = per_proc[pm.pid]
         self._fns: Dict[Tuple, object] = {}
+        self._first_exchange = True
         self.stats = {"rounds": 0, "iterations": 0, "rows_out": 0,
                       "rows_in": 0}
 
@@ -103,28 +142,60 @@ class CollectiveSync:
     # -- the sync protocol --------------------------------------------------
 
     def request_sync(self, karr: np.ndarray, flat: np.ndarray,
-                     lens: np.ndarray) -> np.ndarray:
+                     lens: np.ndarray,
+                     quiescing: bool = True) -> Tuple[np.ndarray, bool]:
         """BSP twin of GlobalPM._request_sync: ship delta rows to owners,
-        return fresh values for every key. `karr` MAY be empty — the
-        process still joins every exchange iteration (collective
-        contract). Iterates per length class in globally-agreed order."""
+        return `(fresh values for every key, all_quiescing)`. `karr` MAY
+        be empty — the process still joins every exchange iteration
+        (collective contract). Iterates per length class in globally-
+        agreed order.
+
+        `quiescing` rides the up-front allreduce: it is True when this
+        process is at a WaitSync/quiesce point and False for a cadence
+        exchange (--sys.collective_cadence). `all_quiescing` tells a
+        waiting process whether every peer has reached its wait point —
+        the termination test of the quiesce-time flag loop that absorbs
+        skewed per-process cadence counts (core/sync.py)."""
         pm = self.pm
-        from .pm import _offsets, _select_flat
+        from .pm import _offsets
         offs = _offsets(lens)
         fresh = np.empty(offs[-1], dtype=np.float32)
         self.stats["rounds"] += 1
-        # one up-front allreduce of per-class counts: classes nobody has
-        # items for are skipped entirely (a WaitSync point with nothing to
-        # ship costs one tiny collective, not 2 exchanges per class)
+        with _JoinWatchdog(pm.pid, "request_sync"):
+            if self._first_exchange:
+                # Align ranks before the FIRST gloo/ICI context creation:
+                # the backend's collective-context init has a hard ~30 s
+                # peer deadline, and per-rank first-compiles (e.g. one
+                # rank just compiled its replica-install program, the
+                # others did not) can skew arrival past it. The
+                # coordination-service barrier has a long timeout and
+                # absorbs that skew once; later exchanges reuse the
+                # established context. Inside the watchdog: an asymmetric
+                # first join must log, not hang bare.
+                control.barrier("adapm-coll-init")
+                self._first_exchange = False
+            return self._request_sync_inner(karr, flat, lens, offs, fresh,
+                                            quiescing)
+
+    def _request_sync_inner(self, karr, flat, lens, offs, fresh,
+                            quiescing):
+        pm = self.pm
+        from .pm import _select_flat
+        # one up-front allreduce of per-class counts (+ the quiescing
+        # flag in the last slot): classes nobody has items for are
+        # skipped entirely (a WaitSync point with nothing to ship costs
+        # one tiny collective, not 2 exchanges per class)
         ncls = len(pm.server.class_lengths)
-        my_counts = np.zeros(ncls, dtype=np.float64)
+        my_counts = np.zeros(ncls + 1, dtype=np.float64)
         cls_pos = []
         for cid in range(ncls):
             pos = np.nonzero(pm.server.ab.key_class[karr] == cid)[0] \
                 if len(karr) else np.empty(0, dtype=np.int64)
             cls_pos.append(pos)
             my_counts[cid] = len(pos)
+        my_counts[ncls] = 1.0 if quiescing else 0.0
         global_counts = control.allreduce(my_counts, "sum")
+        all_quiescing = bool(global_counts[ncls] >= self._P)
         for cid, L in enumerate(pm.server.class_lengths):
             if global_counts[cid] == 0:
                 continue
@@ -133,7 +204,7 @@ class CollectiveSync:
             self._class_loop(cid, L, karr[pos] if len(karr) else
                              np.empty(0, np.int64), rows, pos, fresh,
                              offs, lens)
-        return fresh
+        return fresh, all_quiescing
 
     def _class_loop(self, cid: int, L: int, keys: np.ndarray,
                     rows: np.ndarray, pos: np.ndarray, fresh: np.ndarray,

@@ -52,6 +52,7 @@ import numpy as np
 from ..base import NOT_CACHED, MgmtTechniques
 from . import control
 from .dcn import DcnChannel
+from ..utils.log import alog
 
 # client-side redirect-retry budget: transient misses (a request racing an
 # ownership transfer) resolve within a hop or two once the adoption lands;
@@ -156,6 +157,11 @@ class GlobalPM:
         # sync_manager.h:504-519; hops==1 means the location cache or
         # manager pointed straight at the owner)
         self.hops = np.zeros(3, dtype=np.int64)
+        # guards hops/stats increments from concurrent _drive invocations
+        # (_exec_r threads) and serve-pool handlers: numpy/int in-place
+        # adds are not atomic, so unguarded counts silently undercount
+        import threading as _threading
+        self._stats_lock = _threading.Lock()
 
         # Serializes "delta in flight" windows: a cross-process sync round
         # holds this across extract -> ship -> refresh; anything that
@@ -250,7 +256,8 @@ class GlobalPM:
                     f"{what}: ownership metadata did not converge for keys "
                     f"{keys[pending][:5].tolist()}...")
             if tries > 2:
-                self.stats["redirects"] += len(pending)
+                with self._stats_lock:
+                    self.stats["redirects"] += len(pending)
                 time.sleep(min(0.002 * tries, 0.1))
             still: List[np.ndarray] = []
             # freeze this round's grouping: redirect handling below mutates
@@ -272,26 +279,46 @@ class GlobalPM:
                     if d != self.pid:
                         futs[d] = self._exec_fan.submit(
                             self.chan.request, d, make_msg(keys[pos], pos))
-            for d, pos in groups:
-                if d in futs:
-                    reply = futs[d].result()
-                else:
-                    msg = make_msg(keys[pos], pos)
-                    reply = serve_local(msg) if d == self.pid \
-                        else self.chan.request(d, msg)
-                served = reply[0].astype(bool)
-                owners = merge(reply, pos)
-                self.hops[min(tries, 3) - 1] += int(served.sum())
-                self._learn(keys[pos][served], owners[served])
-                uns = pos[~served]
-                if len(uns):
-                    hint = owners[~served]
-                    home = self.home_proc(keys[uns])
-                    # hint == self means an adoption by our own planner is
-                    # in flight; keep routing to the local handler until it
-                    # lands (the retry backoff gives it time)
-                    dest[uns] = np.where(hint >= 0, hint, home)
-                    still.append(uns)
+            try:
+                for d, pos in groups:
+                    if d in futs:
+                        reply = futs.pop(d).result()
+                    else:
+                        msg = make_msg(keys[pos], pos)
+                        reply = serve_local(msg) if d == self.pid \
+                            else self.chan.request(d, msg)
+                    served = reply[0].astype(bool)
+                    owners = merge(reply, pos)
+                    with self._stats_lock:
+                        self.hops[min(tries, 3) - 1] += int(served.sum())
+                    self._learn(keys[pos][served], owners[served])
+                    uns = pos[~served]
+                    if len(uns):
+                        hint = owners[~served]
+                        home = self.home_proc(keys[uns])
+                        # hint == self means an adoption by our own
+                        # planner is in flight; keep routing to the local
+                        # handler until it lands (the retry backoff gives
+                        # it time)
+                        dest[uns] = np.where(hint >= 0, hint, home)
+                        still.append(uns)
+            except BaseException:
+                # A failed destination must not leave sibling in-flight
+                # requests half-done: they were already SERVED remotely
+                # (deltas merged at owners, intents registered), so drain
+                # their replies before propagating — the caller sees one
+                # failure, not silent remote/local divergence. Replies
+                # drained here are discarded; _drive failures are fatal
+                # to the op, and the retry path re-resolves ownership.
+                for d, f in futs.items():
+                    try:
+                        f.result(timeout=30.0)
+                        alog(f"pm{self.pid}: {what}: drained reply from "
+                             f"{d} after sibling failure (discarded)")
+                    except Exception as e2:
+                        alog(f"pm{self.pid}: {what}: drain of {d} also "
+                             f"failed: {e2!r}")
+                raise
             pending = np.concatenate(still) if still \
                 else np.empty(0, dtype=np.int64)
 
@@ -325,7 +352,8 @@ class GlobalPM:
         offs = _offsets(lens)
         out = np.zeros(offs[-1], dtype=np.float32)
         owners = np.empty(len(keys), dtype=np.int32)
-        self.stats["pulls_in"] += len(keys)
+        with self._stats_lock:
+            self.stats["pulls_in"] += len(keys)
         with srv._lock:
             owned = srv.ab.owner[keys] >= 0
             pos = np.nonzero(owned)[0]
@@ -387,7 +415,8 @@ class GlobalPM:
         lens = srv.value_lengths[keys]
         offs = _offsets(lens)
         owners = np.empty(len(keys), dtype=np.int32)
-        self.stats["pushes_in"] += len(keys)
+        with self._stats_lock:
+            self.stats["pushes_in"] += len(keys)
         with srv._lock:
             owned = srv.ab.owner[keys] >= 0
             pos = np.nonzero(owned)[0]
@@ -452,7 +481,8 @@ class GlobalPM:
         out = np.zeros(offs[-1], dtype=np.float32)
         counters = np.zeros(n, dtype=np.int32)
         owners = np.empty(n, dtype=np.int32)
-        self.stats["intents_in"] += n
+        with self._stats_lock:
+            self.stats["intents_in"] += n
         bit = np.uint64(1) << np.uint64(req)
         rel_keys = np.empty(0, dtype=np.int64)
         with srv._lock:
@@ -688,7 +718,8 @@ class GlobalPM:
         offs = _offsets(lens)
         out = np.zeros(offs[-1], dtype=np.float32)
         owners = np.empty(len(keys), dtype=np.int32)
-        self.stats["syncs_in"] += len(keys)
+        with self._stats_lock:
+            self.stats["syncs_in"] += len(keys)
         bit = np.uint64(1) << np.uint64(req)
         with srv._lock:
             owned = srv.ab.owner[keys] >= 0
@@ -790,27 +821,36 @@ class GlobalPM:
         fresh = self._request_sync(karr, shipped)
         self._install_fresh(karr, sarr, cs_all, class_rows, lens, offs,
                             fresh)
-        self.stats["keys_synced_out"] += len(items)
+        with self._stats_lock:
+            self.stats["keys_synced_out"] += len(items)
 
-    def collective_sync(self, items: List[Tuple[int, int]]) -> None:
+    def collective_sync(self, items: List[Tuple[int, int]],
+                        quiescing: bool = True) -> bool:
         """BSP replica refresh over device collectives
         (parallel/collective.py): same contract as sync_replicas, but
         EVERY process must call this together (the WaitSync/quiesce
-        protocol) — `items` may be empty and the process still joins each
-        exchange. Enabled by --sys.collective_sync."""
+        protocol, or a --sys.collective_cadence clock boundary) — `items`
+        may be empty and the process still joins each exchange. Enabled
+        by --sys.collective_sync. Returns True iff every process entered
+        this exchange with quiescing=True (the cadence flag loop's
+        termination test, core/sync.py)."""
         assert self.coll is not None, "--sys.collective_sync is off"
         with self._delta_mutex:
             ext = self._extract_deltas(items)
             if ext is None:
                 empty = np.empty(0, dtype=np.int64)
-                self.coll.request_sync(empty, np.empty(0, np.float32),
-                                       empty)
-                return
+                _, all_q = self.coll.request_sync(
+                    empty, np.empty(0, np.float32), empty,
+                    quiescing=quiescing)
+                return all_q
             karr, sarr, cs_all, class_rows, lens, offs, shipped = ext
-            fresh = self.coll.request_sync(karr, shipped, lens)
+            fresh, all_q = self.coll.request_sync(karr, shipped, lens,
+                                                  quiescing=quiescing)
             self._install_fresh(karr, sarr, cs_all, class_rows, lens,
                                 offs, fresh)
-            self.stats["keys_synced_out"] += len(karr)
+            with self._stats_lock:
+                self.stats["keys_synced_out"] += len(karr)
+            return all_q
 
     def drop_replicas(self, items: List[Tuple[int, int]]) -> None:
         """Drop local replicas of remote-owned keys: ship the final delta

@@ -24,11 +24,20 @@ vs_baseline = tpu_triples_per_sec / (64 * torch_cpu_per_core_triples_per_sec).
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "pm",
 "w2v_pairs_per_sec", "dedup"}.
+
+Wedge-proofing (round 5): the driver process never imports jax. Every phase
+runs in a subprocess with a hard timeout (`--phase NAME` re-entry), and the
+backend is probed first. A wedged TPU relay (observed rounds 4-5:
+`jax.devices()` hangs forever) therefore degrades the artifact — the probe
+times out, device phases rerun with JAX_PLATFORMS=cpu, and the JSON line
+carries `"tpu_unavailable": true` — instead of killing the whole benchmark
+with rc=1 and losing the round's evidence.
 """
 from __future__ import annotations
 
 import json
 import os
+import subprocess
 import time
 
 # the adaptive phase runs on 8 virtual CPU shards in the same process;
@@ -346,28 +355,75 @@ def bench_cpu_torch(E=200_000, R=1_000, d=128, B=4096, N=32,
     return B / best
 
 
-def main():
-    tput, srv = bench_tpu()
-    kernel_stats = {
-        "rounds": srv.sync.stats.rounds,
-        "intents_processed": srv.sync.stats.intents_processed,
-    }
+# ---------------------------------------------------------------- phases
+# Re-entry points: `python bench.py --phase NAME` runs one phase and prints
+# one JSON line on stdout. The driver (main) runs each in a subprocess with
+# a hard timeout so a wedged backend cannot take down the whole artifact.
+
+def _phase_probe():
+    import jax
+    devs = jax.devices()
+    return {"platform": devs[0].platform, "n_devices": len(devs)}
+
+
+# Degraded (CPU-fallback) sizes: the full-size kge phase needs ~10 min
+# just to compile+warm on the 8-virtual-shard host mesh, so when the TPU
+# is unavailable the driver sets ADAPM_BENCH_SMALL=1 and the phases run a
+# small (honestly-labeled) configuration that keeps the artifact alive.
+_SMALL = {"E": 50_000, "d": 32, "B": 1024, "N": 8}
+
+
+def _kge_sizes() -> dict:
+    if os.environ.get("ADAPM_BENCH_SMALL"):
+        return dict(_SMALL)
+    return {}
+
+
+def _phase_kge():
+    sz = _kge_sizes()
+    tput, srv = bench_tpu(steps=16 if sz else 50, warmup=2 if sz else 5,
+                          **sz)
+    out = {"tput": tput,
+           "rounds": srv.sync.stats.rounds,
+           "intents_processed": srv.sync.stats.intents_processed}
+    if sz:
+        out["small_sizes"] = sz
     srv.shutdown()
+    return out
+
+
+def _phase_scan():
     # K-step scan window (VERDICT r3 item 2): one dispatch trains 8 steps
-    _progress("scan-window phase (K=8)")
-    tput_scan, srv_s = bench_tpu(steps=12, scan_steps=8)
-    srv_s.shutdown()
+    sz = _kge_sizes()
+    tput, srv = bench_tpu(steps=8 if sz else 12, scan_steps=8, **sz)
+    srv.shutdown()
+    return {"tput": tput}
+
+
+def _phase_dedup():
     # dedup lever (docs/PERF.md): all-unique batches bound what a perfect
     # in-step dedup could gain over the skewed batches
-    _progress("dedup phase")
-    tput_unique, srv2 = bench_tpu(steps=24, dedup_batches=True)
-    srv2.shutdown()
-    _progress("adaptive-pm phase (8 virtual CPU shards)")
-    pm = bench_adaptive_pm()
-    pm.update(kernel_stats)
-    _progress("w2v phase")
-    w2v = bench_w2v()
-    _progress("cpu-baseline phase")
+    sz = _kge_sizes()
+    tput, srv = bench_tpu(steps=8 if sz else 24, dedup_batches=True, **sz)
+    srv.shutdown()
+    return {"tput": tput}
+
+
+def _phase_pm():
+    import jax
+    out = bench_adaptive_pm()
+    out["virtual_shards"] = len(jax.devices("cpu"))
+    return out
+
+
+def _phase_w2v():
+    if os.environ.get("ADAPM_BENCH_SMALL"):
+        return {"pairs_per_sec": bench_w2v(V=20_000, d=64, B=2048,
+                                           steps=16, warmup=2)}
+    return {"pairs_per_sec": bench_w2v()}
+
+
+def _phase_cpu():
     # measured per-core CPU throughput of a strong batched torch
     # implementation of the same step; the paper's 8-node x 8-thread
     # cluster is modeled as 64 such cores (conservative: AdaPM's
@@ -375,25 +431,185 @@ def main():
     # The reference binary itself cannot be built in this image — its
     # ZMQ/Boost/Eigen dependencies are absent and installs are forbidden
     # (BASELINE.md "Measured baselines").
-    cpu = bench_cpu_torch()
+    return {"per_core_triples_per_sec": bench_cpu_torch()}
+
+
+_PHASES = {"probe": _phase_probe, "kge": _phase_kge, "scan": _phase_scan,
+           "dedup": _phase_dedup, "pm": _phase_pm, "w2v": _phase_w2v,
+           "cpu": _phase_cpu}
+
+# generous per-phase walls: a healthy phase finishes in a fraction of
+# these; a wedged relay burns one wall once, then the driver degrades
+_TIMEOUTS = {"probe": 120, "kge": 1200, "scan": 900, "dedup": 900,
+             "pm": 900, "w2v": 900, "cpu": 600}
+
+_CPU_ENV = {"JAX_PLATFORMS": "cpu", "ADAPM_PLATFORM": "cpu",
+            "ADAPM_BENCH_SMALL": "1"}
+
+
+def _run_phase(name: str, env_extra: dict | None = None) -> dict:
+    """Run one phase in a subprocess; never raises. Returns the phase's
+    JSON dict, or {"error": ...} on timeout / crash / unparseable output."""
+    _progress(f"phase {name}: starting "
+              f"(timeout {_TIMEOUTS[name]}s, env {env_extra or {}})")
+    env = dict(os.environ)
+    env.update(env_extra or {})
+    cmd = [sys.executable, os.path.abspath(__file__), "--phase", name]
+    try:
+        p = subprocess.run(cmd, capture_output=True, text=True, env=env,
+                           timeout=_TIMEOUTS[name])
+    except subprocess.TimeoutExpired as e:
+        tail = ((e.stderr or b"")[-800:] if isinstance(e.stderr, bytes)
+                else (e.stderr or "")[-800:])
+        _progress(f"phase {name}: TIMEOUT after {_TIMEOUTS[name]}s")
+        return {"error": "timeout", "timeout_s": _TIMEOUTS[name],
+                "stderr_tail": str(tail)}
+    except Exception as e:  # spawn failure — keep the artifact alive
+        return {"error": f"spawn: {e!r}"}
+    if p.stderr:
+        sys.stderr.write(p.stderr[-4000:])
+        sys.stderr.flush()
+    if p.returncode != 0:
+        _progress(f"phase {name}: rc={p.returncode}")
+        return {"error": f"rc={p.returncode}",
+                "stderr_tail": p.stderr[-800:]}
+    try:
+        out = json.loads(p.stdout.strip().splitlines()[-1])
+    except Exception:
+        return {"error": "unparseable", "stdout_tail": p.stdout[-800:]}
+    _progress(f"phase {name}: done {out}")
+    return out
+
+
+def _ok(r: dict) -> bool:
+    return "error" not in r
+
+
+def main():
+    # 1) Probe the default backend with a hard timeout. A wedged TPU relay
+    # hangs jax.devices() forever (observed r4/r5); in that case every
+    # device phase reruns on the host CPU so the round still produces a
+    # parseable, honestly-labeled artifact.
+    probe = _run_phase("probe")
+    tpu_ok = _ok(probe) and probe.get("platform") not in ("cpu", None)
+    dev_env: dict | None = None if tpu_ok else dict(_CPU_ENV)
+    platform = probe.get("platform") if _ok(probe) else "cpu"
+    if not tpu_ok:
+        _progress("backend unavailable or cpu-only: device phases degrade "
+                  "to JAX_PLATFORMS=cpu")
+
+    results: dict = {}
+    for name in ("kge", "scan", "dedup", "w2v"):
+        r = _run_phase(name, dev_env)
+        if not _ok(r) and dev_env is None:
+            # relay wedged mid-run: degrade the remaining device phases
+            # (and retry this one) on CPU rather than burning every wall
+            _progress(f"phase {name} failed on {platform}; degrading "
+                      "remaining device phases to cpu")
+            tpu_ok = False
+            dev_env = dict(_CPU_ENV)
+            results[name + "_tpu_error"] = r
+            r = _run_phase(name, dev_env)
+        if _ok(r):
+            # per-phase provenance: a mid-run degrade must not let small
+            # CPU numbers masquerade as (or mix with) full-size chip ones
+            r["platform_used"] = platform if dev_env is None else "cpu"
+            r["small_sizes_used"] = dev_env is not None
+        results[name] = r
+    # host-only phases (always CPU by design). The adaptive-pm phase's
+    # virtual shard count follows the host's cores: XLA's in-process
+    # collective rendezvous has a hard ~40 s watchdog, and 8 concurrent
+    # participants on a 1-2 core host stall past it (observed SIGABRT in
+    # AllReduceThunk on a 1-core runner); fewer shards still exercise
+    # replication/relocation/sync.
+    cores = os.cpu_count() or 1
+    pm_env = dict(_CPU_ENV)
+    pm_shards = 8 if cores >= 4 else 2
+    pm_env["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={pm_shards}")
+    results["pm"] = _run_phase("pm", pm_env)
+    results["cpu"] = _run_phase("cpu")
+
+    def phase_val(name, field):
+        return results[name].get(field, 0.0) if _ok(results[name]) else 0.0
+
+    def phase_ctx(name):
+        """(platform_used, small) — or (None, None) for a failed phase."""
+        r = results[name]
+        if not _ok(r):
+            return None, None
+        return r.get("platform_used"), r.get("small_sizes_used")
+
+    tput = phase_val("kge", "tput")
+    tput_scan = phase_val("scan", "tput")
+    tput_unique = phase_val("dedup", "tput")
+    w2v = phase_val("w2v", "pairs_per_sec")
+    kge_ctx = phase_ctx("kge")
+    # ratios are only meaningful between phases run on the SAME platform
+    # at the SAME sizes (a mid-run degrade mixes full-size chip numbers
+    # with small CPU ones — comparing those is noise, not a gain)
+    scan_comparable = tput > 0 and phase_ctx("scan") == kge_ctx
+    dedup_comparable = tput > 0 and phase_ctx("dedup") == kge_ctx
+    pm = results["pm"] if _ok(results["pm"]) else {"error": "pm failed"}
+    if _ok(results["kge"]):
+        pm = dict(pm)
+        pm["rounds"] = results["kge"].get("rounds")
+        pm["intents_processed"] = results["kge"].get("intents_processed")
+    cpu = (results["cpu"].get("per_core_triples_per_sec", 0.0)
+           if _ok(results["cpu"]) else 0.0)
     baseline = 64.0 * cpu
-    best = max(tput, tput_scan)
-    print(json.dumps({
+    best = max(tput, tput_scan) if scan_comparable else tput
+    kge_on_tpu = _ok(results["kge"]) and \
+        results["kge"].get("platform_used") not in ("cpu", None)
+    out = {
         "metric": "kge_complex_train_throughput_pm",
         "value": round(best, 1),
         "unit": "triples/sec through the PM (intent+sync in loop; "
                 "d=128, B=4096, N=32 negs, E=200k, power-law skew; "
                 "best of per-step dispatch and K=8 scan window)",
-        "vs_baseline": round(best / baseline, 3),
+        "vs_baseline": (round(best / baseline, 3)
+                        if baseline and kge_on_tpu else None),
+        "platform": kge_ctx[0] or "none",
+        "phase_platforms": {n: phase_ctx(n)[0]
+                            for n in ("kge", "scan", "dedup", "w2v")},
         "per_step_triples_per_sec": round(tput, 1),
         "scan8_triples_per_sec": round(tput_scan, 1),
-        "scan_gain": round(tput_scan / tput - 1.0, 3),
+        "scan_gain": (round(tput_scan / tput - 1.0, 3)
+                      if scan_comparable else None),
         "pm": pm,
         "w2v_pairs_per_sec": round(w2v, 1),
         "dedup": {"unique_batch_triples_per_sec": round(tput_unique, 1),
-                  "gain_vs_skewed": round(tput_unique / tput - 1.0, 3)},
-    }))
+                  "gain_vs_skewed":
+                      (round(tput_unique / tput - 1.0, 3)
+                       if dedup_comparable else None)},
+    }
+    if not kge_on_tpu:
+        # honest degraded record: the headline number is host-CPU at
+        # reduced sizes (ADAPM_BENCH_SMALL), NOT the chip; vs_baseline
+        # would compare different platforms/sizes and is voided above
+        out["tpu_unavailable"] = True
+        out["degraded_sizes"] = _SMALL
+        out["probe"] = probe
+    elif not tpu_ok:
+        # TPU died mid-run: the kge headline IS a chip number, but later
+        # phases degraded to CPU (see phase_platforms)
+        out["tpu_degraded_midrun"] = True
+    errs = {k: v for k, v in results.items() if not _ok(v)}
+    if errs:
+        out["phase_errors"] = errs
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) >= 3 and sys.argv[1] == "--phase":
+        # The TPU tunnel's sitecustomize bakes jax_platforms into the live
+        # config at interpreter start, so the env var alone cannot force
+        # CPU (tests/conftest.py documents the same); update the config
+        # before any backend is touched.
+        _plat = os.environ.get("ADAPM_PLATFORM")
+        if _plat:
+            import jax
+            jax.config.update("jax_platforms", _plat)
+        print(json.dumps(_PHASES[sys.argv[2]]()))
+    else:
+        main()

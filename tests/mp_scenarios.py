@@ -210,6 +210,63 @@ def scenario_eventual():
     print(f"MP-OK eventual rank={rank}")
 
 
+def scenario_cadence():
+    """Bounded staleness with --sys.collective_cadence K (VERDICT r4 item
+    3): rank 1 holds a replica of a rank-0-owned key; rank 0 pushes and
+    NOBODY calls WaitSync — the replica must still observe the push
+    within ~K clock advances, because every process joins a BSP exchange
+    at each K-clock boundary of its run_round loop. All ranks run the
+    same fixed number of steps (no early exit: an exchange needs every
+    process)."""
+    K = 4
+    srv = adapm_tpu.setup(16, 4, opts=SystemOptions(
+        sync_max_per_sec=0, collective_sync=True, collective_bucket=8,
+        collective_cadence=K))
+    rank = control.process_id()
+    w = srv.make_worker(0)
+    k = owned_by_proc(srv, 0, 1)
+    if rank == 0:
+        w.wait(w.set(k, np.full((1, 4), 1.0, np.float32)))
+    srv.barrier()
+    # every rank subscribes: the owner-local interest forces REPLICATE
+    # (not relocate) for rank 1 (sync_manager.h:624-644 decision)
+    w.intent(k, 0, CLOCK_MAX)
+    srv.wait_sync()
+    srv.barrier()
+    if rank == 1:
+        ok, v = w.pull_if_local(k)
+        assert ok and abs(float(np.ravel(v)[0]) - 1.0) < 1e-6, \
+            f"rank 1: replica not installed ({ok}, {v})"
+    if rank == 0:
+        w.wait(w.push(k, np.full((1, 4), 1.0, np.float32)))
+    srv.barrier()  # push applied at the owner before anyone counts clocks
+    seen_at = None
+    for step in range(4 * K):
+        w.advance_clock()
+        srv.sync.run_round()
+        if rank == 1 and seen_at is None:
+            ok, v = w.pull_if_local(k)
+            if ok and abs(float(np.ravel(v)[0]) - 2.0) < 1e-6:
+                seen_at = step
+    if rank == 1:
+        assert seen_at is not None, \
+            f"replica never observed the push in {4 * K} clocks"
+        assert seen_at <= K + 1, \
+            f"staleness bound violated: observed at step {seen_at} > K={K}"
+        print(f"[cadence] observed after {seen_at + 1} clocks (K={K})")
+    # quiesce protocol still holds in cadence mode
+    srv.quiesce()
+    srv.barrier()
+    srv.quiesce()
+    final = 2.0
+    v = srv.read_main(k) if rank == 0 else None
+    if rank == 0:
+        assert abs(float(np.asarray(v)[0]) - final) < 1e-6
+    srv.barrier()
+    srv.shutdown()
+    print(f"MP-OK cadence rank={rank}")
+
+
 def scenario_location_caches():
     """3 processes: after a relocation 0 -> 1, rank 2's first pull routes
     via the manager (redirect) and LEARNS the owner; the second goes one
@@ -568,6 +625,7 @@ SCENARIOS = {
     "intent_locality": scenario_intent_locality,
     "monotonic": scenario_monotonic,
     "eventual": scenario_eventual,
+    "cadence": scenario_cadence,
     "location_caches": scenario_location_caches,
     "ckpt_save": scenario_ckpt_save,
     "ckpt_restore": scenario_ckpt_restore,

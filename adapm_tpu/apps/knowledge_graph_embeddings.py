@@ -85,6 +85,10 @@ class KgeRun:
         self._pool_eval_chunk = 0
         self._pool_eval_keys = None  # staged padded entity-key tiles
         self._pool_eval_router = None
+        self._pool_eval_mp = None    # candidate-partitioned mp variant
+        self._pool_eval_topo = -1    # owned-tile cache topology version
+        self._pool_eval_n = 0        # this rank's owned-entity count
+        self._true_score = None
         self.runner = FusedStepRunner(
             self.srv, make_kge_loss(args.model, args.self_adv_temp),
             role_class={"s": self.ent_class, "r": self.rel_class,
@@ -208,15 +212,18 @@ def _side_stats(sc: np.ndarray, true_e: np.ndarray, fi: np.ndarray,
 def evaluate(run: KgeRun, triples: np.ndarray, batch: int = 64):
     """Filtered MRR / Hits@{1,10} over `triples`, both-side ranking.
 
-    Production path (--eval_chunk > 0, single process): candidate rows are
-    gathered from the POOL in [B, chunk] device tiles and only [B] rank
-    counts return to the host (make_pool_eval_counts) — no dense entity
-    matrix anywhere, which is what makes 4.6M-entity eval feasible
-    (VERDICT r3 item 4). --eval_chunk 0 falls back to the dense-matrix
-    path (also used multi-process, where remote rows are not in the local
-    pool)."""
-    if run.args.eval_chunk > 0 and run.srv.glob is None:
-        return _evaluate_pool(run, triples, batch)
+    Production path (--eval_chunk > 0): candidate rows are gathered from
+    the POOL in [B, chunk] device tiles and only [B] rank counts return
+    to the host — no dense entity matrix anywhere, which is what makes
+    4.6M-entity eval feasible (VERDICT r3 item 4). Single process:
+    make_pool_eval_counts over all entities. Multi-process: the
+    candidate-partitioned variant — every rank must call evaluate() with
+    the SAME triples; counts merge inside (_evaluate_pool_mp, VERDICT r4
+    item 5). --eval_chunk 0 falls back to the dense-matrix path."""
+    if run.args.eval_chunk > 0:
+        if run.srv.glob is None:
+            return _evaluate_pool(run, triples, batch)
+        return _evaluate_pool_mp(run, triples, batch)
     import jax.numpy as jnp
     ent, _, rel, _ = run.current_model()
     ent_j, rel_j = jnp.asarray(ent), jnp.asarray(rel)
@@ -285,33 +292,160 @@ def _evaluate_pool(run: KgeRun, triples: np.ndarray, batch: int):
         g_o = np.asarray(g_o).astype(np.int64)
         g_s = np.asarray(g_s).astype(np.int64)
         true_sc = np.asarray(true_sc)
-        # filtered-rank correction: subtract the (tiny) per-triple filter
-        # sets' contributions, scored on host from a handful of pool rows
-        for g, fi, fe, true_e, q in (
-                (g_o, *_flt_pairs(list(zip(s.tolist(), r.tolist())), sr_o),
-                 o, "o"),
-                (g_s, *_flt_pairs(list(zip(r.tolist(), o.tolist())), ro_s),
-                 s, "s")):
-            if not len(fi):
-                continue
-            fe_rows = emb_rows(run.ekey(fe), run.ent_dim)
-            r_rows = emb_rows(run.rkey(r[fi]), run.rel_dim)
-            if q == "o":
-                sc_f = score_numpy(run.args.model,
-                                   emb_rows(run.ekey(s[fi]), run.ent_dim),
-                                   r_rows, fe_rows)
-            else:
-                sc_f = score_numpy(run.args.model, fe_rows, r_rows,
-                                   emb_rows(run.ekey(o[fi]), run.ent_dim))
-            contrib = (sc_f > true_sc[fi]) & (fe != true_e[fi])
-            np.subtract.at(g, fi, contrib.astype(np.int64))
-            # host f64 vs device f32 can disagree by an ulp at a tie: a
-            # filter entity the device never counted must not push the
-            # count negative (rank 0 -> infinite MRR)
-            np.maximum(g, 0, out=g)
+        _filter_correct(run, emb_rows, s, r, o, g_o, g_s, true_sc,
+                        sr_o, ro_s)
         stats[:4] += _rank_side_stats(g_o)
         stats[4:] += _rank_side_stats(g_s)
     return stats
+
+
+def _filter_correct(run, emb_rows, s, r, o, g_o, g_s, true_sc,
+                    sr_o, ro_s) -> None:
+    """Filtered-rank correction (in place on g_o/g_s): subtract the
+    (tiny) per-triple filter sets' contributions, scored on host from a
+    handful of pool rows."""
+    from ..models.kge import score_numpy
+    for g, fi, fe, true_e, q in (
+            (g_o, *_flt_pairs(list(zip(s.tolist(), r.tolist())), sr_o),
+             o, "o"),
+            (g_s, *_flt_pairs(list(zip(r.tolist(), o.tolist())), ro_s),
+             s, "s")):
+        if not len(fi):
+            continue
+        fe_rows = emb_rows(run.ekey(fe), run.ent_dim)
+        r_rows = emb_rows(run.rkey(r[fi]), run.rel_dim)
+        if q == "o":
+            sc_f = score_numpy(run.args.model,
+                               emb_rows(run.ekey(s[fi]), run.ent_dim),
+                               r_rows, fe_rows)
+        else:
+            sc_f = score_numpy(run.args.model, fe_rows, r_rows,
+                               emb_rows(run.ekey(o[fi]), run.ent_dim))
+        contrib = (sc_f > true_sc[fi]) & (fe != true_e[fi])
+        np.subtract.at(g, fi, contrib.astype(np.int64))
+        # host f64 vs device f32 can disagree by an ulp at a tie: a
+        # filter entity the device never counted must not push the
+        # count negative (rank 0 -> infinite MRR)
+        np.maximum(g, 0, out=g)
+
+
+def _evaluate_pool_mp(run: KgeRun, triples: np.ndarray, batch: int):
+    """Candidate-partitioned pool eval across processes (VERDICT r4 item
+    5). Every rank walks the SAME full triple set; each scores only the
+    entities it OWNS, gathered from its local pool (each entity has
+    exactly one owner, so the per-rank greater-counts allreduce-SUM to
+    exactly the global counts — reference distributed Evaluator,
+    kge.cc:544-775). Query rows come via Server.read_main (remote owners
+    resolve over the DCN channel), the true score is a shared
+    shape-identical executable so its bytes match on every rank
+    (models/kge.make_true_score), and ONE collective per evaluate() call
+    merges the counts. No dense entity matrix, no remote candidate-row
+    fetches. Contract: all ranks call evaluate() together with identical
+    `triples` (the quiesced, no-training-in-flight state the dense mp
+    path already assumed)."""
+    from ..models.kge import make_pool_eval_counts_mp, make_true_score
+    from ..ops import DeviceRouter
+    from ..parallel import control
+    srv = run.srv
+    C = min(run.args.eval_chunk, max(run.E, 8))
+    put = srv.ctx.put_replicated
+    if run._pool_eval_mp is None or run._pool_eval_chunk != C:
+        run._pool_eval_mp = make_pool_eval_counts_mp(
+            run.args.model, run.ent_dim, run.rel_dim, C)
+        run._true_score = make_true_score(run.args.model)
+        run._pool_eval_chunk = C
+        run._pool_eval_topo = -1
+        run._pool_eval_router = DeviceRouter(srv, 0)
+    topo = srv.topology_version
+    if run._pool_eval_topo != topo:
+        # the owned set follows relocations: rebuild the candidate tiles
+        # whenever placement changed since the last eval
+        ekeys = run.ekey(np.arange(run.E)).astype(np.int64)
+        with srv._lock:
+            owned = ekeys[srv.ab.owner[ekeys] >= 0]
+        nown = len(owned)
+        if nown:
+            nch = -(-nown // C)
+            pad = np.full(nch * C, owned[0], dtype=np.int64)
+            pad[:nown] = owned
+            run._pool_eval_keys = put(pad.reshape(nch, C))
+        else:  # a rank may own no entities; it still joins the merge
+            run._pool_eval_keys = None
+        run._pool_eval_n = nown
+        run._pool_eval_topo = topo
+    counts_fn = run._pool_eval_mp
+    router = run._pool_eval_router
+    sr_o, ro_s = run.ds.filters()
+
+    def emb_rows(keys, dim):
+        rows = np.asarray(srv.read_main(keys)).reshape(len(keys), -1)
+        return rows[:, :dim]
+
+    T = len(triples)
+    G_o = np.zeros(T, dtype=np.int64)
+    G_s = np.zeros(T, dtype=np.int64)
+    true_all = np.zeros(T, dtype=np.float32)
+    for lo in range(0, T, batch):
+        t = triples[lo:lo + batch]
+        s, r, o = t[:, 0], t[:, 1], t[:, 2]
+        se = put(emb_rows(run.ekey(s), run.ent_dim))
+        re_ = put(emb_rows(run.rkey(r), run.rel_dim))
+        oe = put(emb_rows(run.ekey(o), run.ent_dim))
+        t_sc = run._true_score(se, re_, oe)
+        true_all[lo:lo + len(t)] = np.asarray(t_sc)
+        if run._pool_eval_n:
+            with srv._lock:
+                tables = router.tables()
+                g_o, g_s = counts_fn(
+                    srv.stores[run.ent_class].main, tables,
+                    run._pool_eval_keys, np.int32(run._pool_eval_n),
+                    se, re_, oe, put(run.ekey(s)), put(run.ekey(o)),
+                    t_sc)
+            G_o[lo:lo + len(t)] = np.asarray(g_o)
+            G_s[lo:lo + len(t)] = np.asarray(g_s)
+    # merge the candidate partitions: ONE collective per evaluate() call.
+    # The preceding coordination-service barrier absorbs per-rank count/
+    # compile skew vs the backend's ~30 s collective-context deadline
+    # (same pattern as parallel/collective.py's first-exchange barrier).
+    control.barrier("adapm-eval-merge")
+    gg = control.allreduce(
+        np.concatenate([G_o, G_s]).astype(np.float64), "sum")
+    G_o = gg[:T].astype(np.int64)
+    G_s = gg[T:].astype(np.int64)
+
+    # correction + stats over GLOBAL counts, identical on every rank
+    stats = np.zeros(EVAL_LEN, dtype=np.float64)
+    for lo in range(0, T, batch):
+        t = triples[lo:lo + batch]
+        s, r, o = t[:, 0], t[:, 1], t[:, 2]
+        g_o = G_o[lo:lo + len(t)]
+        g_s = G_s[lo:lo + len(t)]
+        _filter_correct(run, emb_rows, s, r, o, g_o, g_s,
+                        true_all[lo:lo + len(t)], sr_o, ro_s)
+        stats[:4] += _rank_side_stats(g_o)
+        stats[4:] += _rank_side_stats(g_s)
+    return stats
+
+
+def _eval_global(run: KgeRun, triples: np.ndarray) -> np.ndarray:
+    """Global filtered-eval stats across processes. Pool path
+    (--eval_chunk > 0) multi-process: candidate-partitioned — every rank
+    walks the full triple set and the counts merge INSIDE evaluate(), so
+    its return is already global (identical on all ranks). Dense path /
+    single process: triples split over ranks, partial stats merged by
+    the PS-key allreduce (reference distributed Evaluator idiom)."""
+    from ..parallel import control
+    P = control.num_processes()
+    if P > 1 and run.args.eval_chunk > 0:
+        return evaluate(run, triples)
+    part = np.array_split(triples, P)[control.process_id()]
+    stats = evaluate(run, part)
+    if P == 1:
+        return np.asarray(stats, dtype=np.float64)
+    agg = np.asarray(run.allreduce(run.eval_key_l, stats),
+                     dtype=np.float64)
+    run.reset_key(run.eval_key_l, EVAL_LEN)
+    return agg
 
 
 def run_app(args) -> dict:
@@ -488,15 +622,7 @@ def run_app(args) -> dict:
 
         if args.eval_every and (epoch + 1) % args.eval_every == 0 and \
                 ds.valid is not None and len(ds.valid):
-            # eval work splits over processes; the PS-key allreduce below
-            # merges the partial stats (reference distributed Evaluator)
-            from ..parallel import control
-            ev = np.array_split(ds.valid[:args.eval_triples],
-                                control.num_processes()
-                                )[control.process_id()]
-            stats = evaluate(run, ev)
-            agg = run.allreduce(run.eval_key_l, stats)
-            run.reset_key(run.eval_key_l, EVAL_LEN)
+            agg = _eval_global(run, ds.valid[:args.eval_triples])
             cnt = max(float(agg[3]) + float(agg[7]), 1.0)
             result.update(
                 mrr=(float(agg[0]) + float(agg[4])) / cnt,
@@ -520,12 +646,7 @@ def run_app(args) -> dict:
             break
 
     if ds.test is not None and len(ds.test) and args.eval_every:
-        from ..parallel import control
-        tv = np.array_split(ds.test[:args.eval_triples],
-                            control.num_processes())[control.process_id()]
-        stats = evaluate(run, tv)
-        agg = run.allreduce(run.eval_key_l, stats)
-        run.reset_key(run.eval_key_l, EVAL_LEN)
+        agg = _eval_global(run, ds.test[:args.eval_triples])
         cnt = max(float(agg[3]) + float(agg[7]), 1.0)
         result.update(
             test_mrr=(float(agg[0]) + float(agg[4])) / cnt,

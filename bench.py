@@ -45,7 +45,12 @@ import time
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8").strip()
+        _flags + " --xla_force_host_platform_device_count=8"
+        # XLA CPU's in-process collective rendezvous kills the process
+        # after 40 s if participants straggle; 8 participants serialized
+        # on a 1-2 core host legitimately take that long on big programs
+        " --xla_cpu_collective_call_warn_stuck_timeout_seconds=120"
+        " --xla_cpu_collective_call_terminate_timeout_seconds=900").strip()
 
 import sys
 
@@ -526,7 +531,9 @@ def main():
     pm_env = dict(_CPU_ENV)
     pm_shards = 8 if cores >= 4 else 2
     pm_env["XLA_FLAGS"] = (
-        f"--xla_force_host_platform_device_count={pm_shards}")
+        f"--xla_force_host_platform_device_count={pm_shards}"
+        " --xla_cpu_collective_call_warn_stuck_timeout_seconds=120"
+        " --xla_cpu_collective_call_terminate_timeout_seconds=900")
     results["pm"] = _run_phase("pm", pm_env)
     results["cpu"] = _run_phase("cpu")
 

@@ -125,6 +125,47 @@ def wrap_batches(n: int, batch_size: int, rng: Optional[np.random.Generator]
         yield idx
 
 
+class ScanWindow:
+    """The apps' shared --scan_steps dispatch contract (KGE/w2v/MF): a
+    full K-batch window trains in ONE lax.scan dispatch
+    (DeviceRoutedRunner.run_scan) followed by K * sync_rounds_per_step
+    planner rounds; a partial tail window falls back to per-step dispatch
+    (one compiled scan variant per K, and tails are rare). Batches in one
+    window must come from ONE worker shard — flush at worker/block
+    boundaries."""
+
+    def __init__(self, server, K: int, sync_rounds_per_step: int,
+                 on_loss=None):
+        self.server = server
+        self.K = K
+        self.rounds = sync_rounds_per_step
+        self.on_loss = on_loss or (lambda loss: None)
+        self.buf: list = []  # (runner, roles, aux)
+
+    def add(self, runner, roles, aux, lr) -> None:
+        self.buf.append((runner, roles, aux))
+        if len(self.buf) == self.K:
+            self.flush(lr)
+
+    def flush(self, lr) -> None:
+        if not self.buf:
+            return
+        runner = self.buf[0][0]
+        if len(self.buf) == self.K and self.K > 1:
+            has_aux = self.buf[0][2] is not None
+            self.on_loss(runner.run_scan(
+                [r for _, r, _ in self.buf],
+                [a for _, _, a in self.buf] if has_aux else None, lr))
+            for _ in range(len(self.buf) * self.rounds):
+                self.server.sync.run_round()
+        else:
+            for rn, roles, aux in self.buf:
+                self.on_loss(rn(roles, aux, lr))
+                for _ in range(self.rounds):
+                    self.server.sync.run_round()
+        self.buf.clear()
+
+
 class RuntimeGuard:
     """max_runtime cutoff (reference apps' --max_runtime). The decision is
     COLLECTIVE in a multi-process run: every rank must leave the epoch

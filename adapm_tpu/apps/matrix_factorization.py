@@ -26,9 +26,10 @@ from ..io import mf as mfio
 from ..models.mf import make_mf_loss
 from ..ops import DeviceRoutedRunner, FusedStepRunner
 from ..utils import Stopwatch, alog
-from .common import (KeyMapper, RuntimeGuard, add_common_arguments,
-                     enforce_full_replication, epoch_report, make_server,
-                     wrap_batches, worker0_init)
+from .common import (KeyMapper, RuntimeGuard, ScanWindow,
+                     add_common_arguments, enforce_full_replication,
+                     epoch_report, make_server, wrap_batches,
+                     worker0_init)
 
 
 def _load_data(args):
@@ -102,8 +103,27 @@ def run(args) -> float:
     guard = RuntimeGuard(args.max_runtime)
     watch = Stopwatch(start=True)
 
+    # --scan_steps K (device-routed only): buffer K batches and train
+    # them in ONE lax.scan dispatch (ScanWindow — the shared app
+    # contract; placement frozen per window). The clock still advances
+    # per batch at buffering time; intent windows are extended by K-1
+    # clocks to cover the dispatch delay. The window is flushed at every
+    # worker/block boundary (shards must not mix in one window) and
+    # before each barrier/quiesce. lr changes per epoch (bold driver), so
+    # the CURRENT lr is passed at every add/flush.
+    K = max(1, args.scan_steps) if args.device_routes else 1
+    scan_win = ScanWindow(srv, K, args.sync_rounds_per_step)
+
+    def flush_scan():
+        scan_win.flush(lr)
+
     def train_batch(w, idx):
         roles = {"w": kmap(rows[idx]), "h": kmap(cols[idx] + m)}
+        if args.device_routes and K > 1:
+            scan_win.add(device_runner(w.shard), roles,
+                         np.asarray(vals[idx]), lr)
+            w.advance_clock()
+            return None
         if args.device_routes:
             loss = device_runner(w.shard)(roles, np.asarray(vals[idx]), lr)
         else:
@@ -115,7 +135,7 @@ def run(args) -> float:
 
     def signal_intent(w, idx, start, end):
         ks = np.concatenate([kmap(rows[idx]), kmap(cols[idx] + m)])
-        w.intent(np.unique(ks), start, end)
+        w.intent(np.unique(ks), start, end + (K - 1))
 
     for epoch in range(args.epochs):
         if args.algorithm == "dsgd":
@@ -140,6 +160,7 @@ def run(args) -> float:
                     # every fused step has one static shape (one XLA compile)
                     for idx in wrap_batches(len(blk), B, rng):
                         train_batch(w, blk[idx])
+                    flush_scan()
                 srv.barrier()  # per-subepoch barrier (reference :409-458)
         elif args.algorithm == "columnwise":
             for wi, w in enumerate(workers):
@@ -153,6 +174,7 @@ def run(args) -> float:
                                       w.current_clock + args.lookahead,
                                       w.current_clock + args.lookahead + 1)
                     train_batch(w, mine[idx])
+                flush_scan()
         else:  # plain SGD
             for wi, w in enumerate(workers):
                 mine = by_worker[wi]
@@ -164,6 +186,7 @@ def run(args) -> float:
                                       w.current_clock + args.lookahead,
                                       w.current_clock + args.lookahead + 1)
                     train_batch(w, mine[idx])
+                flush_scan()
 
         srv.quiesce()
         Wc, Hc = _current_factors(srv, kmap, m, n, rank)
@@ -214,6 +237,11 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--l2", type=float, default=0.01)
     parser.add_argument("--algorithm", default="dsgd",
                         choices=["dsgd", "columnwise", "plain"])
+    parser.add_argument("--scan_steps", type=int, default=1,
+                        help="batches trained per device dispatch "
+                             "(lax.scan window, runner.run_scan; device "
+                             "routing only — same contract as the KGE "
+                             "app's --scan_steps)")
     parser.add_argument("--lookahead", type=int, default=2,
                         help="intent batches ahead (columnwise/plain)")
     parser.add_argument("--adagrad_init", type=float, default=1e-6)

@@ -27,9 +27,10 @@ from ..models.sgns import (build_alias_table, build_unigram_table,
                            sgns_loss, subsample_mask, syn0_key, syn1_key)
 from ..ops import DeviceRoutedRunner, FusedStepRunner
 from ..utils import Stopwatch, alog
-from .common import (KeyMapper, RuntimeGuard, add_common_arguments,
-                     enforce_full_replication, epoch_report,
-                     global_worker_slices, make_server, worker0_init)
+from .common import (KeyMapper, RuntimeGuard, ScanWindow,
+                     add_common_arguments, enforce_full_replication,
+                     epoch_report, global_worker_slices, make_server,
+                     worker0_init)
 
 
 def _pairs_for(sent: np.ndarray, sent_idx: int, window: int, seed: int,
@@ -114,6 +115,23 @@ def run(args) -> float:
     # workers (reference :524-531)
     slices = global_worker_slices(len(sents), num_workers)
 
+    # --scan_steps K (device-routed only): buffer K materialized batches
+    # and train them in ONE lax.scan dispatch (runner.run_scan — same
+    # contract as the KGE app: placement frozen per window, negative RNG
+    # identical to K sequential steps). Clocks still advance per
+    # SENTENCE; a buffered batch waits up to ~K*B/pairs-per-sentence
+    # clocks before dispatch, so intent windows are extended by a slack
+    # estimated from the corpus (otherwise replicas could expire while a
+    # batch sits in the window).
+    K = max(1, args.scan_steps) if args.device_routes else 1
+    scan_slack = 0
+    if K > 1:
+        probe = [len(_pairs_for(sents[si], si, args.window, args.seed,
+                                counts, total_words, args.sample)[0])
+                 for si in range(min(50, len(sents)))]
+        est_pairs = max(1.0, float(np.mean(probe)) if probe else 1.0)
+        scan_slack = int(np.ceil(K * B / est_pairs)) * 2 + K
+
     for epoch in range(args.epochs):
         losses = []
         for wi, w in enumerate(workers):
@@ -136,7 +154,7 @@ def run(args) -> float:
                 fut = w.current_clock + ahead
                 ks = np.unique(np.concatenate(
                     [kmap(syn0_key(c)), kmap(syn1_key(x))]))
-                w.intent(ks, fut, fut + 1)
+                w.intent(ks, fut, fut + 1 + scan_slack)
                 h = None if args.device_routes else \
                     w.prepare_sample(len(c) * N, fut, fut + 1)
                 prepared.append((pos, h, c, x))
@@ -144,6 +162,9 @@ def run(args) -> float:
             # prime the pipeline
             for pos in range(min(args.readahead, len(my))):
                 prepare(pos, ahead=pos)
+
+            scan_win = ScanWindow(srv, K, args.sync_rounds_per_step,
+                                  on_loss=losses.append)
 
             n_buf = 0
             for pos in range(len(my)):
@@ -170,14 +191,20 @@ def run(args) -> float:
                     cc = np.concatenate(buf_c)
                     xx = np.concatenate(buf_x)
                     nn = np.concatenate(buf_n) if buf_n else None
-                    losses.append(step(cc[:B], xx[:B],
-                                       None if nn is None else nn[:B]))
+                    if K > 1:
+                        scan_win.add(device_runner(w.shard),
+                                     {"center": cc[:B], "ctx": xx[:B]},
+                                     None, args.lr)
+                    else:
+                        losses.append(step(cc[:B], xx[:B],
+                                           None if nn is None else nn[:B]))
+                        for _ in range(args.sync_rounds_per_step):
+                            srv.sync.run_round()
                     buf_c, buf_x = [cc[B:]], [xx[B:]]
                     buf_n = [] if nn is None else [nn[B:]]
                     n_buf -= B
-                    for _ in range(args.sync_rounds_per_step):
-                        srv.sync.run_round()
                 w.advance_clock()
+            scan_win.flush(args.lr)  # partial window at worker end
             # tail: wrap-pad the remaining pairs into one final batch
             if n_buf > 0:
                 cc = np.concatenate(buf_c)
@@ -188,8 +215,9 @@ def run(args) -> float:
                     np.tile(cc, reps)[:B], np.tile(xx, reps)[:B],
                     None if nn is None else np.tile(nn, (reps, 1))[:B]))
         srv.quiesce()
-        mean_loss = float(np.mean([float(l) for l in losses])) \
-            if losses else 0.0
+        # scan windows contribute [K] loss vectors, per-step path scalars
+        mean_loss = float(np.mean(np.concatenate(
+            [np.ravel(np.asarray(l)) for l in losses]))) if losses else 0.0
         from ..parallel import control
         mean_loss = float(control.allreduce(mean_loss, "mean")[0])
         epoch_report("w2v", epoch, mean_loss, watch)
@@ -232,6 +260,11 @@ def build_parser() -> argparse.ArgumentParser:
                              "(word2vec.cc --sample; 0 disables)")
     parser.add_argument("--readahead", type=int, default=1000,
                         help="sentences of intent/sample lookahead")
+    parser.add_argument("--scan_steps", type=int, default=1,
+                        help="batches trained per device dispatch "
+                             "(lax.scan window, runner.run_scan; device "
+                             "routing only — same contract as the KGE "
+                             "app's --scan_steps)")
     parser.add_argument("--device_routes",
                         action=argparse.BooleanOptionalAction, default=True,
                         help="device-routed fused step + in-program "

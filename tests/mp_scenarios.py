@@ -410,13 +410,15 @@ def scenario_kge_eval_chunk():
     reads the full entity matrix via read_main) on the same triples."""
     from adapm_tpu.apps import knowledge_graph_embeddings as kge
     from adapm_tpu.io import kge as kgeio
-    rank = control.process_id()
     args = kge.build_parser().parse_args(
         ["--dim", "8", "--synthetic_entities", "60",
          "--synthetic_relations", "4", "--synthetic_triples", "300",
          "--eval_chunk", "16", "--sys.sync.max_per_sec", "0"])
     ds = kgeio.generate_synthetic(60, 4, 300, seed=1)
+    # KgeRun joins the distributed runtime; jax.process_index() before it
+    # would initialize the backend and break jax.distributed.initialize
     run = kge.KgeRun(args, ds)
+    rank = control.process_id()
     run.init_model()  # random model: rank equivalence needs no training
     trip = ds.test[:60]
     pool = kge.evaluate(run, trip)   # mp pool path: counts merge inside

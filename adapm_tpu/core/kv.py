@@ -876,6 +876,7 @@ class Server:
     def shutdown(self) -> None:
         self.stop_sync_thread()
         self.block()
+        self.sync.close()
         self.write_stats()
         if self.glob is not None:
             from ..parallel import control
@@ -941,6 +942,19 @@ class Server:
     def quiesce(self) -> None:
         with self._round_lock:
             self.sync.quiesce()
+
+    def collective_pull(self, keys) -> np.ndarray:
+        """BSP pull through the device-collective exchange — EVERY process
+        must call this together (parallel/pm.py collective_pull;
+        --sys.collective_sync). Returns owner values, flat."""
+        assert self.glob is not None, "single process: use Worker.pull"
+        return self.glob.collective_pull(keys)
+
+    def collective_push(self, keys, vals) -> None:
+        """BSP additive push through the device-collective exchange — same
+        collective contract as collective_pull."""
+        assert self.glob is not None, "single process: use Worker.push"
+        self.glob.collective_push(keys, vals)
 
     def read_main(self, keys) -> np.ndarray:
         """Debug/test/checkpoint: read current authoritative main-copy
@@ -1139,8 +1153,8 @@ class Worker:
         after = self._live_write_futs() if srv.glob is not None else ()
         # Set may invalidate (consume the delta of) cross-process replicas;
         # that must not interleave with an in-flight sync round's extracted
-        # delta (pm.py _delta_mutex; taken BEFORE the server lock)
-        dm = srv.glob._delta_mutex if srv.glob is not None \
+        # delta (pm.py delta_window; taken BEFORE the server lock)
+        dm = srv.glob.delta_window_for(keys) if srv.glob is not None \
             else contextlib.nullcontext()
         with dm:
             with srv._lock:

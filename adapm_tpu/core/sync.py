@@ -29,13 +29,25 @@ KNUTH = np.uint64(2654435761)
 
 
 def key_channel(keys: np.ndarray, num_channels: int) -> np.ndarray:
-    """Key -> channel via Knuth multiplicative hash (handle.h:1016-1029)."""
+    """Key -> channel via Knuth multiplicative hash (handle.h:1016-1029).
+
+    The HIGH half of the 32-bit product picks the channel: KNUTH is odd,
+    so the product's low bits are just a permutation of the key's low
+    bits — `h % 2^m` would degenerate to `key % 2^m`, perfectly
+    correlated with the home-process layout (key % (S*P)), and one
+    process's keys would all share a channel (observed in dcn_bench:
+    chan_rounds == 1 at P = 4)."""
     h = (keys.astype(np.uint64) * KNUTH) & np.uint64(0xFFFFFFFF)
-    return (h % np.uint64(num_channels)).astype(np.int32)
+    return ((h >> np.uint64(16)) % np.uint64(num_channels)).astype(
+        np.int32)
 
 
 class SyncStats:
     def __init__(self):
+        # concurrent per-channel rounds (_sync_all_channels) bump these
+        # from several threads; int += is not atomic
+        import threading
+        self.lock = threading.Lock()
         self.rounds = 0
         self.replicas_created = 0
         self.replicas_dropped = 0
@@ -83,6 +95,7 @@ class SyncManager:
         import threading
         self._coll_lock = threading.Lock()
         self._cad_joined = 0
+        self._chan_exec = None  # lazy: concurrent all-channel rounds (mp)
 
     # ------------------------------------------------------------------
     # intent registration + replicate-vs-relocate decision
@@ -224,12 +237,14 @@ class SyncManager:
                     (drop_x if x else drop).append(it)
         if keep:
             srv._sync_replicas(keep, threshold=self.opts.sync_threshold)
-            self.stats.keys_synced += len(keep)
+            with self.stats.lock:
+                self.stats.keys_synced += len(keep)
         if keep_x and not self.opts.collective_sync:
             # collective mode: cross-process deltas accumulate and ship in
             # the BSP exchange at the next WaitSync/quiesce point
             srv.glob.sync_replicas(keep_x)
-            self.stats.keys_synced += len(keep_x)
+            with self.stats.lock:
+                self.stats.keys_synced += len(keep_x)
         if drop or drop_x:
             if srv.tracer is not None:
                 from ..utils.stats import INTENT_STOP
@@ -240,10 +255,12 @@ class SyncManager:
             with srv._lock:
                 for item in drop:
                     reps.discard(item)
-            self.stats.replicas_dropped += len(drop)
+            with self.stats.lock:
+                self.stats.replicas_dropped += len(drop)
         if drop_x:
             srv.glob.drop_replicas(drop_x)  # discards from the channel set
-            self.stats.replicas_dropped += len(drop_x)
+            with self.stats.lock:
+                self.stats.replicas_dropped += len(drop_x)
 
     def run_round(self, force_intents: bool = False,
                   all_channels: bool = False) -> None:
@@ -256,8 +273,7 @@ class SyncManager:
             return
         self.drain_intents(force=force_intents)
         if all_channels:
-            for c in range(self.num_channels):
-                self.sync_channel(c)
+            self._sync_all_channels()
         else:
             self.sync_channel(self._next_channel)
             self._next_channel = (self._next_channel + 1) % self.num_channels
@@ -268,6 +284,48 @@ class SyncManager:
         else:
             self._maybe_cadence()
         self.stats.rounds += 1
+
+    def _sync_all_channels(self) -> None:
+        """All channels' rounds. Multi-process, >1 channel: issued
+        CONCURRENTLY — channels partition keys (per-channel delta locks,
+        pm.delta_window), local device work serializes briefly under the
+        server lock, and the expensive part (per-channel DCN round-trips
+        to owners) overlaps instead of stacking RTTs (VERDICT r4 item 9;
+        reference: C parallel SyncManager threads,
+        coloc_kv_server.h:100-105). Single-process: serial — there is no
+        network latency to hide, only thread overhead to pay."""
+        srv = self.server
+        if srv.glob is None or self.num_channels == 1:
+            for c in range(self.num_channels):
+                self.sync_channel(c)
+            return
+        if self._chan_exec is None:
+            from concurrent.futures import ThreadPoolExecutor
+            self._chan_exec = ThreadPoolExecutor(
+                max_workers=self.num_channels,
+                thread_name_prefix="adapm-chan")
+        futs = [self._chan_exec.submit(self.sync_channel, c)
+                for c in range(self.num_channels)]
+        errs = []
+        for f in futs:
+            try:
+                f.result()
+            except Exception as e:
+                errs.append(e)
+        if errs:
+            # surface every channel's failure: log the others before
+            # raising the first, so concurrent-round diagnostics are not
+            # reduced to whichever channel happened to be joined first
+            from ..utils.log import alog
+            for e in errs[1:]:
+                alog(f"[sync] concurrent channel round also failed: "
+                     f"{type(e).__name__}: {e}")
+            raise errs[0]
+
+    def close(self) -> None:
+        if self._chan_exec is not None:
+            self._chan_exec.shutdown(wait=True)
+            self._chan_exec = None
 
     def _collective_active(self) -> bool:
         srv = self.server

@@ -31,14 +31,20 @@ from each other cannot deadlock.
 from __future__ import annotations
 
 import itertools
+import os
 import pickle
 import socket
 import struct
 import threading
+import time
 from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Callable, Dict, Optional
 
 _LEN = struct.Struct("!Q")
+# benchmark-only latency injection (see _serve.run): emulates a real
+# cross-host RTT on loopback so latency-hiding levers are measurable
+_EMULATED_RTT_S = float(os.environ.get("ADAPM_DCN_EMULATE_RTT_MS", "0")) \
+    / 1e3
 
 
 def _send_msg(sock: socket.socket, obj) -> None:
@@ -193,6 +199,12 @@ class DcnChannel:
         send_lock = threading.Lock()
 
         def run(rid, msg):
+            if _EMULATED_RTT_S > 0.0:
+                # ADAPM_DCN_EMULATE_RTT_MS: benchmark-only latency
+                # injection — loopback RTT is pure CPU, so latency-hiding
+                # levers (channel overlap, request fan-out) cannot show
+                # their effect without it. Never set in production.
+                time.sleep(_EMULATED_RTT_S)
             try:
                 reply = self.handler(msg)
             except Exception as e:  # noqa: BLE001 - ship errors to requester

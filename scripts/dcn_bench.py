@@ -101,12 +101,45 @@ def child() -> None:
     assert (srv.ab.cache_slot[w.shard, batch] >= 0).mean() > 0.9, \
         "expected the working set to be replicated"
     t_sync = timed(lambda: pm.sync_replicas(items))
+
+    # channel overlap (VERDICT r4 item 9): the working set spans all sync
+    # channels (Knuth-hash partition); per-channel rounds hold only their
+    # channel's delta lock, so their DCN round-trips can overlap. Serial
+    # baseline = the pre-r5 planner loop shape.
+    from adapm_tpu.core.sync import key_channel
+    nch = srv.sync.num_channels
+    ch = key_channel(batch, nch)
+    per_chan = [[(int(k), w.shard) for k, c in zip(batch, ch) if c == cc]
+                for cc in range(nch)]
+    per_chan = [it for it in per_chan if it]
+
+    def chan_serial():
+        for it in per_chan:
+            pm.sync_replicas(it)
+
+    chan_pool = ThreadPoolExecutor(len(per_chan))
+
+    def chan_overlap():
+        list(chan_pool.map(pm.sync_replicas, per_chan))
+
+    t_chan_serial = timed(chan_serial)
+    t_chan_overlap = timed(chan_overlap)
+    chan_pool.shutdown(wait=True)
     # the same replica-refresh traffic over the BSP collective data plane
     # (parallel/collective.py): both transports measured in one run so the
     # comparison answers "where each path wins" (VERDICT r3 item 1). All
     # ranks run `timed` with identical round counts, so every
-    # collective_sync call is globally matched.
+    # collective_sync call is globally matched. The barrier separates the
+    # RPC-timed loops above from the exchanges (collective_pull's
+    # DEADLOCK RULE: a rank waiting in an exchange cannot serve RPCs)
+    srv.barrier()
     t_coll = timed(lambda: pm.collective_sync(items))
+    # pull/push over the exchange (VERDICT r4 item 4): the RPC rows above
+    # are the baseline; on loopback RPC usually wins (no bucket padding,
+    # no BSP join) — this records the protocol floor the way r4 did for
+    # sync. All ranks run identical call counts (collective contract).
+    t_cpull = timed(lambda: pm.collective_pull(batch))
+    t_cpush = timed(lambda: pm.collective_push(batch, vals))
 
     srv.barrier()
     mib = BATCH * L * 4 / 2**20
@@ -120,8 +153,14 @@ def child() -> None:
         "pull_keys_per_s_inflight": inflight,
         "sync_round_ms": round(t_sync * 1e3, 2),
         "sync_keys_per_s": round(BATCH / t_sync),
+        "chan_rounds": len(per_chan),
+        "chan_serial_ms": round(t_chan_serial * 1e3, 2),
+        "chan_overlap_ms": round(t_chan_overlap * 1e3, 2),
+        "chan_overlap_speedup": round(t_chan_serial / t_chan_overlap, 2),
         "coll_sync_round_ms": round(t_coll * 1e3, 2),
         "coll_sync_keys_per_s": round(BATCH / t_coll),
+        "coll_pull_keys_per_s": round(BATCH / t_cpull),
+        "coll_push_keys_per_s": round(BATCH / t_cpush),
     }
     if rank == 0:
         print(json.dumps(out), flush=True)

@@ -104,6 +104,15 @@ def test_mp_collective_cadence_staleness_bound():
 
 
 @pytest.mark.slow
+@pytest.mark.parametrize("n", [2, 3])
+def test_mp_collective_pull_push(n):
+    """Pull/Push values ride the device-collective exchange instead of
+    DCN RPC, exactly (VERDICT r4 item 4 — the SURVEY ICI mapping's
+    remaining half, prototyped)."""
+    run_mp(n, "coll_pullpush", devices=1 if n == 3 else 2, timeout=420)
+
+
+@pytest.mark.slow
 def test_mp_kge_eval_chunk_matches_dense():
     """Candidate-partitioned chunked pool eval across 2 processes equals
     the dense-matrix path on the same triples (VERDICT r4 item 5)."""

@@ -533,6 +533,11 @@ def run_app(args) -> dict:
         result["truth_mrr_s"] = ds.truth_mrr_s
 
     for epoch in range(args.epochs):
+        # per-epoch step size: AdaGrad already decays effective rates, but
+        # an explicit multiplicative schedule helps late-stage ranking
+        # quality on the lowrank harness (docs/PERF.md "Quality");
+        # --lr_decay 1.0 = the reference's constant-lr behavior
+        lr_epoch = args.lr * (args.lr_decay ** epoch)
         # losses stay device scalars until epoch end: a float() per step
         # would serialize host and device (docs/PERF.md gap analysis)
         epoch_losses = []
@@ -580,7 +585,7 @@ def run_app(args) -> dict:
                               "o": run.ekey(t[:, 2])} for t in window]
                     epoch_losses.append(
                         device_runner(w.shard).run_scan(
-                            roles, None, args.lr))
+                            roles, None, lr_epoch))
                     for _ in range(K * args.sync_rounds_per_step):
                         srv.sync.run_round()
                     for _ in range(K):
@@ -596,13 +601,15 @@ def run_app(args) -> dict:
                 roles = {"s": run.ekey(t[:, 0]), "r": run.rkey(t[:, 1]),
                          "o": run.ekey(t[:, 2])}
                 if args.device_routes:
-                    loss = device_runner(w.shard)(roles, None, args.lr)
+                    loss = device_runner(w.shard)(roles, None,
+                                                  lr_epoch)
                 else:
                     neg = np.asarray(
                         w.pull_sample_keys(handles[bi], B * N)).reshape(B, N)
                     w.finish_sample(handles.pop(bi))
                     roles["neg"] = neg
-                    loss = run.runner(roles, None, args.lr, shard=w.shard)
+                    loss = run.runner(roles, None, lr_epoch,
+                                      shard=w.shard)
                 epoch_losses.append(loss)
                 for _ in range(args.sync_rounds_per_step):
                     srv.sync.run_round()
@@ -681,6 +688,9 @@ def build_parser() -> argparse.ArgumentParser:
                              "model (learnable by construction)")
     parser.add_argument("--lookahead", type=int, default=4,
                         help="intent/sample batches ahead (kge.cc :1059)")
+    parser.add_argument("--lr_decay", type=float, default=1.0,
+                        help="multiplicative per-epoch lr decay "
+                             "(1.0 = constant, the reference behavior)")
     parser.add_argument("--scan_steps", type=int, default=1,
                         help="K>1: train K batches per device dispatch "
                              "(lax.scan window, runner.run_scan; device "

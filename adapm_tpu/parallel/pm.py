@@ -45,6 +45,7 @@ from __future__ import annotations
 
 import time
 from concurrent.futures import Future, ThreadPoolExecutor
+from contextlib import contextmanager
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -207,26 +208,22 @@ class GlobalPM:
             self.coll = CollectiveSync(self, server.opts.collective_bucket)
         control.barrier("pm-up")
 
+    @contextmanager
     def delta_window(self, channels=None):
         """Context manager holding the delta-in-flight locks for the given
         channel ids (None = all), acquired in channel order."""
-        import contextlib
-
-        @contextlib.contextmanager
-        def cm():
-            cs = self._all_channels if channels is None \
-                else sorted(set(int(c) for c in channels))
-            held = []
-            try:
-                for c in cs:
-                    lk = self._delta_locks[c]
-                    lk.acquire()
-                    held.append(lk)
-                yield
-            finally:
-                for lk in reversed(held):
-                    lk.release()
-        return cm()
+        cs = self._all_channels if channels is None \
+            else sorted(set(int(c) for c in channels))
+        held = []
+        try:
+            for c in cs:
+                lk = self._delta_locks[c]
+                lk.acquire()
+                held.append(lk)
+            yield
+        finally:
+            for lk in reversed(held):
+                lk.release()
 
     def delta_window_for(self, keys: np.ndarray):
         """delta_window over exactly the channels the keys hash to

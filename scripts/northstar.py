@@ -320,6 +320,21 @@ def main():
     which = argv or ["kge", "w2v", "mf"]
     runs = {"kge": lambda: run_kge(full_epoch=full_epoch, do_eval=do_eval),
             "w2v": run_w2v, "w2v_app": run_w2v_app, "mf": run_mf}
+    if os.environ.get("ADAPM_NS_SMOKE", "0").lower() not in \
+            ("", "0", "false"):
+        # CPU smoke of every measurement path at toy scale: keeps the
+        # scripts runnable-first-try when the chip comes back (the r4
+        # round lost its TPU window partly to rediscovering breakage)
+        runs = {
+            "kge": lambda: run_kge(E=20_000, R=20, d=16, B=256, N=4,
+                                   steps=6, train_triples=10_000,
+                                   full_epoch=full_epoch, do_eval=do_eval),
+            "w2v": lambda: run_w2v(V=5_000, d=16, B=512, N=3, steps=6),
+            "w2v_app": lambda: run_w2v_app(V=2_000, sentences=200,
+                                           sent_len=80, d=16, B=512),
+            "mf": lambda: run_mf(users=2_000, movies=1_000, rank=8,
+                                 B=1024, steps=6),
+        }
     for name in which:
         out = runs[name]()
         print(json.dumps(out), flush=True)

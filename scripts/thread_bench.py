@@ -1,0 +1,114 @@
+"""Worker-thread scaling microbench (VERDICT r4 item 7).
+
+The reference expects N worker THREADS per process to scale pull/push
+throughput, protected by a 16384-entry per-key lock array
+(handle.h:1069-1083). Here every worker op takes the one server RLock
+around routing + device dispatch; this bench measures what N threads
+actually buy on this design: aggregate pull and push ops/s at 1/2/4/8
+threads hammering disjoint key slices (the best case for per-key locks,
+the worst case for one server lock).
+
+    python scripts/thread_bench.py            # prints one JSON line
+
+Interpretation caveats, recorded with the numbers in docs/PERF.md:
+  - on a 1-2 core host NOTHING scales (no parallelism to expose); run on
+    a multi-core host to see the lock's cost, not the core count's
+  - numpy routing and XLA dispatch release the GIL, so the RLock is the
+    binding constraint once cores are available
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+os.environ.setdefault("ADAPM_PLATFORM", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+        " --xla_cpu_collective_call_warn_stuck_timeout_seconds=120"
+        " --xla_cpu_collective_call_terminate_timeout_seconds=900").strip()
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache")
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+import numpy as np  # noqa: E402
+
+K = 100_000
+L = 64
+BATCH = 1024
+OPS = 30  # batched ops per thread per timing
+
+
+def main() -> None:
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import adapm_tpu
+    from adapm_tpu.config import SystemOptions
+
+    # declared worker budget covers the per-N thread teams (ids must be
+    # < num_workers; finalize() retires each team after its run)
+    srv = adapm_tpu.setup(K, L, num_workers=64,
+                          opts=SystemOptions(sync_max_per_sec=0,
+                                             cache_slots_per_shard=1))
+    w0 = srv.make_worker(0)
+    rng = np.random.default_rng(0)
+    vals = rng.normal(size=(K, L)).astype(np.float32)
+    slab = 50_000
+    for lo in range(0, K, slab):
+        w0.set(np.arange(lo, min(lo + slab, K)), vals[lo:lo + slab])
+    srv.block()
+
+    def bench(n_threads: int) -> dict:
+        base = {1: 8, 2: 16, 4: 24, 8: 32}[n_threads]
+        workers = [srv.make_worker(base + i) for i in range(n_threads)]
+        # disjoint key slices per thread: per-key locks would make these
+        # perfectly parallel; one server lock serializes them
+        slices = np.array_split(np.arange(K, dtype=np.int64), n_threads)
+        rngs = [np.random.default_rng(t) for t in range(n_threads)]
+        batches = [[rngs[t].choice(sl, BATCH) for _ in range(4)]
+                   for t, sl in enumerate(slices)]
+        ones = np.ones((BATCH, L), np.float32)
+
+        def puller(t):
+            w = workers[t]
+            for i in range(OPS):
+                w.pull_sync(batches[t][i % 4])
+
+        def pusher(t):
+            w = workers[t]
+            for i in range(OPS):
+                w.wait(w.push(batches[t][i % 4], ones))
+
+        out = {}
+        with ThreadPoolExecutor(n_threads) as ex:
+            for name, fn in (("pull", puller), ("push", pusher)):
+                list(ex.map(fn, range(n_threads)))  # warm
+                t0 = time.perf_counter()
+                list(ex.map(fn, range(n_threads)))
+                dt = time.perf_counter() - t0
+                out[name] = round(n_threads * OPS * BATCH / dt)
+        for w in workers:
+            w.finalize()
+        return out
+
+    results = {n: bench(n) for n in (1, 2, 4, 8)}
+    print(json.dumps({
+        "metric": "worker_thread_scaling",
+        "host_cores": os.cpu_count(),
+        "batch": BATCH, "value_bytes": 4 * L,
+        "keys_per_s": results,
+        "pull_scaling_8v1": round(results[8]["pull"] /
+                                  results[1]["pull"], 2),
+        "push_scaling_8v1": round(results[8]["push"] /
+                                  results[1]["push"], 2),
+    }))
+    srv.shutdown()
+
+
+if __name__ == "__main__":
+    main()

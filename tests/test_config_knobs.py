@@ -138,6 +138,9 @@ def test_collective_sync_knobs():
     SystemOptions.add_arguments(p)
     off = SystemOptions.from_args(p.parse_args([]))
     assert off.collective_sync is False and off.collective_bucket == 1024
+    assert off.collective_cadence == 0
     on = SystemOptions.from_args(p.parse_args(
-        ["--sys.collective_sync", "1", "--sys.collective_bucket", "256"]))
+        ["--sys.collective_sync", "1", "--sys.collective_bucket", "256",
+         "--sys.collective_cadence", "8"]))
     assert on.collective_sync is True and on.collective_bucket == 256
+    assert on.collective_cadence == 8

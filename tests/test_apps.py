@@ -245,6 +245,31 @@ def test_kge_midscale_levers_beat_uniform():
     assert adv["test_mrr_o"] > uni["test_mrr_o"], (adv, uni)
 
 
+@pytest.mark.slow
+@pytest.mark.skipif((__import__("os").cpu_count() or 1) < 4,
+                    reason="two 25-epoch mid-scale runs (~40+ CPU-min); "
+                           "needs a multi-core host for time")
+def test_kge_lr_decay_beats_constant():
+    """--lr_decay breaks into the round-4 quality plateau (VERDICT r4
+    item 8): at an identical 25-epoch budget on the mid-scale lowrank
+    harness, a 0.93/epoch schedule must clearly beat constant lr.
+    Measured at exactly this config incl. --num_shards 2 (round 5,
+    docs/PERF.md 'Quality'): constant 0.036 (10.6% of ceiling) vs
+    decayed 0.056 (16.4%) — a 1.56x margin against the 1.2x bar."""
+    from adapm_tpu.apps import knowledge_graph_embeddings as kge
+    base = ["--dim", "32", "--neg_ratio", "64",
+            "--synthetic_entities", "5000", "--synthetic_relations", "16",
+            "--synthetic_triples", "60000", "--synthetic_mode", "lowrank",
+            "--epochs", "25", "--batch_size", "1024", "--lr", "0.7",
+            "--self_adv_temp", "3.0", "--neg_sampling", "freq",
+            "--eval_every", "25", "--eval_triples", "500",
+            "--num_shards", "2", "--seed", "0"] + FAST
+    const = kge.run_app(kge.build_parser().parse_args(base))
+    decay = kge.run_app(kge.build_parser().parse_args(
+        base + ["--lr_decay", "0.93"]))
+    assert decay["test_mrr"] > 1.2 * const["test_mrr"], (decay, const)
+
+
 def test_kge_checkpoint_resume(tmp_path):
     """Checkpoint -> resume (reference kge.cc checkpointing :327-401)."""
     from adapm_tpu.apps import knowledge_graph_embeddings as kge

@@ -256,9 +256,11 @@ def _evaluate_pool(run: KgeRun, triples: np.ndarray, batch: int):
     srv = run.srv
     C = min(run.args.eval_chunk, max(run.E, 8))
     put = srv.ctx.put_replicated
+    shared = run.ent_class == run.rel_class
     if run._pool_eval is None or run._pool_eval_chunk != C:
         run._pool_eval = make_pool_eval_counts(
-            run.args.model, run.ent_dim, run.rel_dim, C)
+            run.args.model, run.ent_dim, run.rel_dim, C,
+            shared_pool=shared)
         run._pool_eval_chunk = C
         # the padded full-entity key tiles and the router are per-(E, C)
         # constants — re-uploading them every evaluate() call is a ~37 MiB
@@ -284,9 +286,11 @@ def _evaluate_pool(run: KgeRun, triples: np.ndarray, batch: int):
         s, r, o = t[:, 0], t[:, 1], t[:, 2]
         with srv._lock:
             tables = router.tables()
+            pools = (srv.stores[run.ent_class].main,) if shared else \
+                (srv.stores[run.ent_class].main,
+                 srv.stores[run.rel_class].main)
             g_o, g_s, true_sc = counts_fn(
-                srv.stores[run.ent_class].main,
-                srv.stores[run.rel_class].main, tables, ent_keys_dev,
+                *pools, tables, ent_keys_dev,
                 np.int32(run.E), put(run.ekey(s)), put(run.rkey(r)),
                 put(run.ekey(o)))
         g_o = np.asarray(g_o).astype(np.int64)
@@ -458,7 +462,8 @@ def run_app(args) -> dict:
         ds, truth_mrr = kgeio.generate_lowrank(
             num_entities=args.synthetic_entities,
             num_relations=args.synthetic_relations,
-            n_train=args.synthetic_triples, seed=args.seed)
+            n_train=args.synthetic_triples, seed=args.seed,
+            dim_truth=args.gen_dim_truth, temperature=args.gen_temperature)
         alog(f"[kge] lowrank synthetic: generating-model filtered "
              f"MRR ceiling = {truth_mrr:.4f} (o={ds.truth_mrr_o:.4f} "
              f"s={ds.truth_mrr_s:.4f})")
@@ -686,6 +691,13 @@ def build_parser() -> argparse.ArgumentParser:
                         choices=["permutation", "lowrank"],
                         help="lowrank = drawn from a ground-truth ComplEx "
                              "model (learnable by construction)")
+    parser.add_argument("--gen_dim_truth", type=int, default=16,
+                        help="lowrank generator: rank of the ground-truth "
+                             "ComplEx model")
+    parser.add_argument("--gen_temperature", type=float, default=0.25,
+                        help="lowrank generator: softmax temperature for "
+                             "object sampling (higher = flatter object "
+                             "marginal, lower truth ceiling)")
     parser.add_argument("--lookahead", type=int, default=4,
                         help="intent/sample batches ahead (kge.cc :1059)")
     parser.add_argument("--lr_decay", type=float, default=1.0,

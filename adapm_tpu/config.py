@@ -61,6 +61,14 @@ class SystemOptions:
     # are absorbed by the quiesce-time flag loop.
     collective_cadence: int = 0
 
+    # -- optimistic routing (reference per-key lock array,
+    #    handle.h:1069-1083): worker Pull/Push route + stage OUTSIDE the
+    #    server lock against a topology_version snapshot, then revalidate
+    #    under the lock and re-plan on a miss. Shrinks the serialized
+    #    critical section to the device dispatch itself so N worker
+    #    threads scale on multi-core hosts; off = route under the lock.
+    optimistic_routing: bool = True
+
     # -- ActionTimer (sys.timing.*; reference sync_manager.h:62-158)
     timing_alpha: float = 0.1
     timing_quantile: float = 0.9999
@@ -115,6 +123,8 @@ class SystemOptions:
                        dest="sys_collective_cadence", type=int, default=0)
         g.add_argument("--sys.main_over_alloc", dest="sys_main_over_alloc",
                        type=float, default=1.25)
+        g.add_argument("--sys.optimistic_routing",
+                       dest="sys_optimistic_routing", type=int, default=1)
         g.add_argument("--sys.stats.out", dest="sys_stats_out", default=None)
         g.add_argument("--sys.trace.keys", dest="sys_trace_keys", default=None)
         g.add_argument("--sys.stats.locality", dest="sys_stats_locality",
@@ -149,6 +159,7 @@ class SystemOptions:
             collective_bucket=args.sys_collective_bucket,
             collective_cadence=args.sys_collective_cadence,
             main_over_alloc=args.sys_main_over_alloc,
+            optimistic_routing=bool(args.sys_optimistic_routing),
             stats_out=args.sys_stats_out,
             trace_keys=args.sys_trace_keys,
             locality_stats=args.sys_stats_locality,

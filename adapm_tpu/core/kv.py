@@ -239,16 +239,20 @@ class Server:
     # -- routing helpers (host) ---------------------------------------------
 
     def _route(self, keys: np.ndarray, shard: int,
-               write_through: bool = False):
+               write_through: bool = False, record: bool = True):
         """Resolve keys (any shape) to pool coordinates for a worker on
         `shard`, preferring a local replica over the owner row (the single
         routing policy shared by Pull/Push and the fused step, ops/fused.py).
-        Returns (o_sh, o_sl, c_sh, c_sl, use_c, n_remote): owner shard+slot,
-        replica shard+slot (OOB where none), replica mask, remote-key count.
-        Locality stats are recorded here (the one place all data-plane ops
-        pass through); `write_through` marks ops that must reach the owner
-        regardless of replicas (Set), so a replica doesn't count as local.
-        Uses the native router (adapm_tpu/native) when available."""
+        Returns (o_sh, o_sl, c_sh, c_sl, use_c, n_remote, local): owner
+        shard+slot, replica shard+slot (OOB where none), replica mask,
+        remote-key count, and the per-key locality mask (THE definition of
+        "local" — dispatch-time stats reuse it instead of restating the
+        policy). Locality stats are recorded here unless `record=False`
+        (optimistic planning: a plan that fails topology revalidation is
+        recomputed, and must not count twice); `write_through` marks ops
+        that must reach the owner regardless of replicas (Set), so a
+        replica doesn't count as local. Uses the native router
+        (adapm_tpu/native) when available."""
         ab = self.ab
         if self._native is not None:
             from ..native import route
@@ -256,13 +260,14 @@ class Server:
             o_sh, o_sl, c_sh, c_sl, use_c, n_remote, local = route(
                 self._native, flat, ab.owner, ab.slot,
                 ab.cache_slot[shard], shard, int(OOB), write_through)
-            if self.locality is not None:
+            if record and self.locality is not None:
                 self.locality.record(flat, local)
             sh = keys.shape
             o_sh, o_sl = o_sh.reshape(sh), o_sl.reshape(sh)
             c_sh, c_sl = c_sh.reshape(sh), c_sl.reshape(sh)
             use_c = use_c.reshape(sh)
-            return o_sh, o_sl, c_sh, c_sl, use_c, n_remote
+            return o_sh, o_sl, c_sh, c_sl, use_c, n_remote, \
+                local.reshape(sh)
         # numpy fallback: match the native path's bounds behavior
         from ..base import check_key_range
         check_key_range(keys, self.num_keys)
@@ -273,11 +278,11 @@ class Server:
         on_owner = o_sh == shard
         local = on_owner if write_through else (use_c | on_owner)
         n_remote = int((~local).sum())
-        if self.locality is not None:
+        if record and self.locality is not None:
             self.locality.record(keys.ravel(), local.ravel())
         c_sh = np.full_like(o_sh, shard)
         c_sl = np.where(use_c, cs, OOB).astype(np.int32)
-        return o_sh, o_sl, c_sh, c_sl, use_c, n_remote
+        return o_sh, o_sl, c_sh, c_sl, use_c, n_remote, local
 
     def _group_by_class(self, keys: np.ndarray):
         """Split a key batch by length class; returns [(cid, positions)]."""
@@ -301,44 +306,67 @@ class Server:
 
     # -- core ops (called by Worker; all under the server lock) --------------
 
-    def _pull(self, keys: np.ndarray, shard: int, after=()):
-        """Returns (groups, n_remote, remote): one gather per length class.
-        `remote` is (positions, Future) for process-remote keys served over
-        the DCN channel (multi-process only); `after` futures are this
-        worker's outstanding remote writes (read-your-writes ordering)."""
-        remote = None
+    def _plan_pull(self, keys: np.ndarray, shard: int):
+        """Routing plan for `_pull`: no device dispatch, no side effects.
+        Safe to call WITHOUT the server lock — it reads only the fixed-size
+        in-place-mutated addressbook tables, and every table mutation bumps
+        `topology_version` under the lock, so callers revalidate the
+        version under the lock before dispatching and re-plan on a miss
+        (optimistic routing; the reference instead shards per-key locks so
+        N worker threads route concurrently, handle.h:1069-1083)."""
+        rem = None
         loc_map = None
         if self.glob is not None:
             proc_rem = (self.ab.owner[keys] < 0) & \
                 (self.ab.cache_slot[shard, keys] < 0)
             if proc_rem.any():
                 rem_pos = np.nonzero(proc_rem)[0]
-                fut = self.glob.pull_async(keys[rem_pos], after=after)
-                remote = (rem_pos, fut)
+                rem = (rem_pos, keys[rem_pos])
                 loc_map = np.nonzero(~proc_rem)[0]
                 keys = keys[loc_map]
+        cls = []
+        if len(keys):
+            for cid, pos in self._group_by_class(keys):
+                ks = keys[pos]
+                cls.append((cid, pos, ks,
+                            self._route(ks, shard, record=False)))
+        return (rem, loc_map, cls)
+
+    def _pull(self, keys: np.ndarray, shard: int, after=(), plan=None):
+        """Returns (groups, n_remote, remote): one gather per length class.
+        `remote` is (positions, Future) for process-remote keys served over
+        the DCN channel (multi-process only); `after` futures are this
+        worker's outstanding remote writes (read-your-writes ordering).
+        `plan` is an optional pre-computed `_plan_pull` result (must have
+        been revalidated against `topology_version` under the lock)."""
+        if plan is None:
+            plan = self._plan_pull(keys, shard)
+        rem, loc_map, cls = plan
         groups = []
-        n_remote = 0 if remote is None else len(remote[0])
-        if len(keys) == 0:
-            return groups, n_remote, remote
-        for cid, pos in self._group_by_class(keys):
-            ks = keys[pos]
-            o_sh, o_sl, c_sh, c_sl, use_c, nr = self._route(ks, shard)
+        remote = None
+        n_remote = 0
+        if rem is not None:
+            rem_pos, rem_keys = rem
+            fut = self.glob.pull_async(rem_keys, after=after)
+            remote = (rem_pos, fut)
+            n_remote = len(rem_pos)
+        for cid, pos, ks, (o_sh, o_sl, c_sh, c_sl, use_c, nr,
+                           local) in cls:
             n_remote += nr
+            if self.locality is not None:
+                self.locality.record(ks.ravel(), local.ravel())
             o_sl = np.where(use_c, OOB, o_sl).astype(np.int32)
             vals = self.stores[cid].gather(o_sh, o_sl, c_sh, c_sl, use_c)
             gpos = pos if loc_map is None else loc_map[pos]
             groups.append((cid, gpos, self.value_lengths[ks], vals, len(ks)))
         return groups, n_remote, remote
 
-    def _push(self, keys: np.ndarray, vals: np.ndarray, shard: int,
-              is_set: bool = False, after=()):
-        """Returns (n_remote, futures): futures are outstanding cross-process
-        writes (multi-process only; `after` = the worker's earlier write
-        futures, chained to preserve per-worker write order)."""
+    def _plan_push(self, keys: np.ndarray, vals: np.ndarray, shard: int,
+                   is_set: bool = False):
+        """Routing + staging plan for `_push`: no device dispatch, no side
+        effects; same lock-free contract as `_plan_pull`."""
         flat = vals.ndim == 1
-        n_remote = 0
-        futures = []
+        rem = None
         if self.glob is not None:
             # Set must reach the owner; Push may land in a local replica's
             # delta row (same split as the reference's local attempt)
@@ -357,66 +385,86 @@ class Server:
                                             rem_pos)
                 else:
                     rem_flat = np.ascontiguousarray(vals[rem_pos]).ravel()
-                chain = list(after)
-                if is_set:
-                    # Set invalidates any local replicas of these keys: a
-                    # kept replica's pending delta would re-add on top of
-                    # the overwritten value. Flush the delta (ordered
-                    # BEFORE the set) and drop the replica; reads route to
-                    # the owner afterwards.
-                    cs = self.ab.cache_slot[shard, rem_keys]
-                    has = cs >= 0
-                    if has.any():
-                        from ..parallel.pm import _fill_flat
-                        hk = np.unique(rem_keys[has])
-                        lens_h = self.value_lengths[hk]
-                        offs_h = _offsets(lens_h)
-                        dflat = np.zeros(offs_h[-1], np.float32)
-                        for cid, pos in self._group_by_class(hk):
-                            rows = self.stores[cid].read_rows(
-                                "delta",
-                                np.full(len(pos), shard, np.int32),
-                                self.ab.cache_slot[
-                                    shard, hk[pos]].astype(np.int32))
-                            _fill_flat(dflat, offs_h, lens_h, pos,
-                                       rows.ravel())
-                        self._drop_cross_replicas(hk, shard)
-                        chain = chain + [self.glob.write_async(
-                            hk, dflat, is_set=False, after=chain)]
-                fut = self.glob.write_async(
-                    rem_keys, rem_flat.astype(np.float32), is_set,
-                    after=chain)
-                if is_set and proc_rem.any() and len(chain) > len(after):
-                    # the owner keeps serving sync for our dropped replicas
-                    # until we unsubscribe; do it once the set has landed
-                    fut = self.glob.unsub_async(hk, after=[fut])
-                futures.append(fut)
-                if len(self._rw_pending) > 64:
-                    self._prune_rw_pending()
-                self._rw_pending.append((fut, rem_keys))
-                n_remote += len(rem_pos)
                 loc_pos = np.nonzero(~proc_rem)[0]
                 if flat:
                     vals = _select_flat(vals, _offsets(lens), lens, loc_pos)
                 else:
                     vals = vals[loc_pos]
                 keys = keys[loc_pos]
-        for cid, pos in self._group_by_class(keys):
-            ks = keys[pos]
-            L = self.class_lengths[cid]
-            if flat:
-                rows = self._flat_parts(keys, vals, pos, L)
-            else:
-                rows = vals[pos]
-            o_sh, o_sl, c_sh, c_sl, use_c, nr = self._route(
-                ks, shard, write_through=is_set)
+                rem = (rem_pos, rem_keys, rem_flat)
+        cls = []
+        if len(keys):
+            for cid, pos in self._group_by_class(keys):
+                ks = keys[pos]
+                L = self.class_lengths[cid]
+                rows = self._flat_parts(keys, vals, pos, L) if flat \
+                    else vals[pos]
+                cls.append((cid, ks, rows,
+                            self._route(ks, shard, write_through=is_set,
+                                        record=False)))
+        return (rem, cls)
+
+    def _push(self, keys: np.ndarray, vals: np.ndarray, shard: int,
+              is_set: bool = False, after=(), plan=None):
+        """Returns (n_remote, futures): futures are outstanding cross-process
+        writes (multi-process only; `after` = the worker's earlier write
+        futures, chained to preserve per-worker write order). `plan` is an
+        optional `_plan_push` result revalidated under the lock."""
+        if plan is None:
+            plan = self._plan_push(keys, vals, shard, is_set=is_set)
+        rem, cls = plan
+        n_remote = 0
+        futures = []
+        if rem is not None:
+            from ..parallel.pm import _fill_flat, _offsets
+            rem_pos, rem_keys, rem_flat = rem
+            chain = list(after)
+            if is_set:
+                # Set invalidates any local replicas of these keys: a
+                # kept replica's pending delta would re-add on top of
+                # the overwritten value. Flush the delta (ordered
+                # BEFORE the set) and drop the replica; reads route to
+                # the owner afterwards.
+                cs = self.ab.cache_slot[shard, rem_keys]
+                has = cs >= 0
+                if has.any():
+                    hk = np.unique(rem_keys[has])
+                    lens_h = self.value_lengths[hk]
+                    offs_h = _offsets(lens_h)
+                    dflat = np.zeros(offs_h[-1], np.float32)
+                    for cid, pos in self._group_by_class(hk):
+                        rows = self.stores[cid].read_rows(
+                            "delta",
+                            np.full(len(pos), shard, np.int32),
+                            self.ab.cache_slot[
+                                shard, hk[pos]].astype(np.int32))
+                        _fill_flat(dflat, offs_h, lens_h, pos,
+                                   rows.ravel())
+                    self._drop_cross_replicas(hk, shard)
+                    chain = chain + [self.glob.write_async(
+                        hk, dflat, is_set=False, after=chain)]
+            fut = self.glob.write_async(
+                rem_keys, rem_flat.astype(np.float32), is_set,
+                after=chain)
+            if is_set and len(chain) > len(after):
+                # the owner keeps serving sync for our dropped replicas
+                # until we unsubscribe; do it once the set has landed
+                fut = self.glob.unsub_async(hk, after=[fut])
+            futures.append(fut)
+            if len(self._rw_pending) > 64:
+                self._prune_rw_pending()
+            self._rw_pending.append((fut, rem_keys))
+            n_remote += len(rem_pos)
+        for cid, ks, rows, (o_sh, o_sl, c_sh, c_sl, use_c, nr,
+                            local) in cls:
+            n_remote += nr
+            if self.locality is not None:
+                self.locality.record(ks.ravel(), local.ravel())
             if is_set:
                 # Set writes through to the main copy and refreshes the
                 # writer's local replica (store._set_rows docstring)
-                n_remote += nr
                 self.stores[cid].set_rows(o_sh, o_sl, rows, c_sh, c_sl)
             else:
-                n_remote += nr
                 if self._dbg_applies is not None:
                     np.add.at(self._dbg_applies, ks, rows[:, 0])
                 o_sl = np.where(use_c, OOB, o_sl).astype(np.int32)
@@ -1061,9 +1109,18 @@ class Worker:
         keys = self._keys(keys)
         srv = self.server
         after = self._live_write_futs() if srv.glob is not None else ()
+        plan, tv = None, -1
+        if srv.opts.optimistic_routing:
+            # route + stage outside the lock; revalidate the topology
+            # below (reference: per-key lock array lets N worker threads
+            # route concurrently, handle.h:1069-1083)
+            tv = srv.topology_version
+            plan = srv._plan_pull(keys, self.shard)
         with srv._lock:
+            if plan is not None and srv.topology_version != tv:
+                plan = None  # topology moved underneath us: re-plan
             groups, n_remote, remote = srv._pull(keys, self.shard,
-                                                 after=after)
+                                                 after=after, plan=plan)
         self.stats["pull_ops"] += 1
         self.stats["pull_params"] += len(keys)
         self.stats["pull_params_local"] += len(keys) - n_remote
@@ -1115,9 +1172,16 @@ class Worker:
         vals = np.asarray(vals, dtype=np.float32)
         srv = self.server
         after = self._live_write_futs() if srv.glob is not None else ()
+        plan, tv = None, -1
+        if srv.opts.optimistic_routing:
+            tv = srv.topology_version
+            plan = srv._plan_push(keys, vals, self.shard, is_set=False)
         with srv._lock:
+            if plan is not None and srv.topology_version != tv:
+                plan = None
             n_remote, futs = srv._push(keys, vals, self.shard,
-                                       is_set=False, after=after)
+                                       is_set=False, after=after,
+                                       plan=plan)
         self.stats["push_ops"] += 1
         self.stats["push_params"] += len(keys)
         self.stats["push_params_local"] += len(keys) - n_remote
@@ -1156,10 +1220,17 @@ class Worker:
         # delta (pm.py delta_window; taken BEFORE the server lock)
         dm = srv.glob.delta_window_for(keys) if srv.glob is not None \
             else contextlib.nullcontext()
+        plan, tv = None, -1
+        if srv.opts.optimistic_routing:
+            tv = srv.topology_version
+            plan = srv._plan_push(keys, vals, self.shard, is_set=True)
         with dm:
             with srv._lock:
+                if plan is not None and srv.topology_version != tv:
+                    plan = None
                 n_remote, futs = srv._push(keys, vals, self.shard,
-                                           is_set=True, after=after)
+                                           is_set=True, after=after,
+                                           plan=plan)
         self._write_futs.extend(futs)
         if n_remote == 0:
             return LOCAL

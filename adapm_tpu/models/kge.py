@@ -192,7 +192,10 @@ def make_pool_eval_counts_mp(model: str, ent_dim: int, rel_dim: int,
         def body(carry, xs):
             g_o, g_s = carry
             keys, start = xs
-            rows = ent_rows(keys)                        # [C, d]
+            # barrier: see make_pool_eval_counts (blocks the whole-pool
+            # bf16 convert hoist at north-star scale)
+            rows = jax.lax.optimization_barrier(
+                ent_rows(keys))                          # [C, d]
             so, ss = scores_fn(rows, None, se, re_, oe)  # [B, C] each
             mask = (start + jnp.arange(C)) < nvalid
             # exclude the true entity BY KEY (see make_pool_eval_counts)
@@ -214,7 +217,7 @@ def make_pool_eval_counts_mp(model: str, ent_dim: int, rel_dim: int,
 
 
 def make_pool_eval_counts(model: str, ent_dim: int, rel_dim: int,
-                          chunk: int):
+                          chunk: int, shared_pool: bool = False):
     """Full-entity eval WITHOUT materializing the entity matrix: candidate
     rows are gathered straight from the sharded main POOL in [B, chunk]
     tiles under a lax.scan (VERDICT r3 item 4 — at Wikidata5M scale the
@@ -227,7 +230,15 @@ def make_pool_eval_counts(model: str, ent_dim: int, rel_dim: int,
     (greater_o [B], greater_s [B], true_sc [B]): for each side, the
     number of real candidates scoring strictly above the true triple.
     Filtered-rank correction happens on the host over the (tiny)
-    per-triple filter sets (apps/.. evaluate)."""
+    per-triple filter sets (apps/.. evaluate).
+
+    shared_pool=True drops the rel_main parameter and reads relation rows
+    from ent_main — REQUIRED at north-star scale when entities and
+    relations share one length class: the AOT compiler accounts each
+    program parameter's HBM separately even when the caller passes the
+    same buffer twice, so an 8.8 GiB pool passed as both ent_main and
+    rel_main is budgeted at 17.6 GiB and the compile is rejected before
+    any real allocation happens (observed on v5e at 4.6M entities)."""
     score = {"complex": complex_score, "rescal": rescal_score}[model]
     scores_fn = make_eval_scores(model)
 
@@ -241,7 +252,8 @@ def make_pool_eval_counts(model: str, ent_dim: int, rel_dim: int,
 
         se = ent_rows(skeys)
         oe = ent_rows(okeys)
-        re_ = rel_main[owner[rkeys], slot[rkeys], :rel_dim]
+        rpool = ent_main if shared_pool else rel_main
+        re_ = rpool[owner[rkeys], slot[rkeys], :rel_dim]
         true_sc = score(se, re_, oe)  # same triple -> same score each side
 
         C = ent_keys.shape[1]
@@ -249,7 +261,12 @@ def make_pool_eval_counts(model: str, ent_dim: int, rel_dim: int,
         def body(carry, xs):
             g_o, g_s = carry
             keys, start = xs
-            rows = ent_rows(keys)                      # [C, d]
+            # the barrier pins the gathered tile: without it XLA commutes
+            # the matmul's bf16 convert across the gather and hoists it
+            # out of the scan as convert(whole pool) — a pool-sized HLO
+            # temp (4.47 GiB at Wikidata5M scale, compile-time OOM)
+            rows = jax.lax.optimization_barrier(
+                ent_rows(keys))                        # [C, d]
             so, ss = scores_fn(rows, None, se, re_, oe)  # [B, C] each
             mask = (start + jnp.arange(C)) < nE
             # exclude the true entity BY KEY, not by score comparison:
@@ -270,4 +287,10 @@ def make_pool_eval_counts(model: str, ent_dim: int, rel_dim: int,
         (g_o, g_s), _ = jax.lax.scan(body, (z, z), (ent_keys, starts))
         return g_o, g_s, true_sc
 
+    if shared_pool:
+        def counts_shared(ent_main, tables, ent_keys, nE, skeys, rkeys,
+                          okeys):
+            return counts(ent_main, None, tables, ent_keys, nE, skeys,
+                          rkeys, okeys)
+        return counts_shared
     return counts

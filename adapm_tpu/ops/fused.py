@@ -96,7 +96,7 @@ def build_routes(server, keys: np.ndarray, shard: int,
     # multi-process: a key owned by another process cannot be gathered by
     # the local program — make it local first (miss = fetch)
     server.ensure_local(keys, shard)
-    o_sh, o_sl, c_sh, c_sl, use_c, n_remote = server._route(keys, shard)
+    o_sh, o_sl, c_sh, c_sl, use_c, n_remote, _ = server._route(keys, shard)
     g_sl = np.where(use_c, OOB, o_sl).astype(np.int32)
     put = server.ctx.put_replicated  # the staging rule, mesh.py
     return Routes(put(o_sh), put(g_sl), put(c_sh), put(c_sl), put(use_c),

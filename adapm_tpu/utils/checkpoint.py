@@ -113,6 +113,11 @@ def restore_server(server, path: str) -> None:
     assert (ck["value_lengths"] == server.value_lengths).all(), \
         "value-length layout mismatch"
     with server._lock:
+        # the whole addressbook is rewritten below: bump topology_version
+        # so any concurrently-planned optimistic route (core/kv.py
+        # _plan_pull/_plan_push) fails revalidation instead of dispatching
+        # pre-restore coordinates into the restored pools
+        server.topology_version += 1
         ab = server.ab
         ab.owner[:] = ck["owner"]
         ab.slot[:] = ck["slot"]

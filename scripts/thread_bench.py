@@ -2,11 +2,13 @@
 
 The reference expects N worker THREADS per process to scale pull/push
 throughput, protected by a 16384-entry per-key lock array
-(handle.h:1069-1083). Here every worker op takes the one server RLock
-around routing + device dispatch; this bench measures what N threads
-actually buy on this design: aggregate pull and push ops/s at 1/2/4/8
-threads hammering disjoint key slices (the best case for per-key locks,
-the worst case for one server lock).
+(handle.h:1069-1083). This bench measures BOTH locking disciplines:
+`locked_routing` (route + stage + dispatch all under the one server
+RLock — the pre-r5 design) and `optimistic` (the r5 default,
+--sys.optimistic_routing: route + stage outside the lock against a
+topology_version snapshot, only device dispatch serialized). Aggregate
+pull and push ops/s at 1/2/4/8 threads hammering disjoint key slices
+(the best case for per-key locks, the worst case for one coarse lock).
 
     python scripts/thread_bench.py            # prints one JSON line
 
@@ -63,8 +65,11 @@ def main() -> None:
         w0.set(np.arange(lo, min(lo + slab, K)), vals[lo:lo + slab])
     srv.block()
 
+    next_wid = [8]  # ids 0-7 reserved for the init worker's team
+
     def bench(n_threads: int) -> dict:
-        base = {1: 8, 2: 16, 4: 24, 8: 32}[n_threads]
+        base = next_wid[0]
+        next_wid[0] += n_threads
         workers = [srv.make_worker(base + i) for i in range(n_threads)]
         # disjoint key slices per thread: per-key locks would make these
         # perfectly parallel; one server lock serializes them
@@ -96,17 +101,24 @@ def main() -> None:
             w.finalize()
         return out
 
-    results = {n: bench(n) for n in (1, 2, 4, 8)}
-    print(json.dumps({
-        "metric": "worker_thread_scaling",
-        "host_cores": os.cpu_count(),
-        "batch": BATCH, "value_bytes": 4 * L,
-        "keys_per_s": results,
-        "pull_scaling_8v1": round(results[8]["pull"] /
-                                  results[1]["pull"], 2),
-        "push_scaling_8v1": round(results[8]["push"] /
-                                  results[1]["push"], 2),
-    }))
+    # both locking disciplines (r5: optimistic routing moves route+stage
+    # out of the server lock; --sys.optimistic_routing 0 is the old
+    # route-under-lock behavior). On a 1-core host expect parity; on a
+    # multi-core host the optimistic mode is the one that can scale.
+    out = {"metric": "worker_thread_scaling",
+           "host_cores": os.cpu_count(),
+           "batch": BATCH, "value_bytes": 4 * L}
+    for mode, opt in (("locked_routing", False), ("optimistic", True)):
+        srv.opts.optimistic_routing = opt
+        results = {n: bench(n) for n in (1, 2, 4, 8)}
+        out[mode] = {
+            "keys_per_s": results,
+            "pull_scaling_8v1": round(results[8]["pull"] /
+                                      results[1]["pull"], 2),
+            "push_scaling_8v1": round(results[8]["push"] /
+                                      results[1]["push"], 2),
+        }
+    print(json.dumps(out))
     srv.shutdown()
 
 

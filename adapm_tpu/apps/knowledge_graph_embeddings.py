@@ -90,7 +90,7 @@ class KgeRun:
         self._pool_eval_n = 0        # this rank's owned-entity count
         self._true_score = None
         self.runner = FusedStepRunner(
-            self.srv, make_kge_loss(args.model, args.self_adv_temp),
+            self.srv, make_kge_loss(args.model, args.self_adv_temp, args.l2),
             role_class={"s": self.ent_class, "r": self.rel_class,
                         "o": self.ent_class, "neg": self.ent_class},
             role_dim={"s": self.ent_dim, "r": self.rel_dim,
@@ -515,7 +515,7 @@ def run_app(args) -> dict:
     def device_runner(shard: int) -> DeviceRoutedRunner:
         if shard not in dev_runners:
             dev_runners[shard] = DeviceRoutedRunner(
-                srv, make_kge_loss(args.model, args.self_adv_temp),
+                srv, make_kge_loss(args.model, args.self_adv_temp, args.l2),
                 role_class={"s": run.ent_class, "r": run.rel_class,
                             "o": run.ent_class, "neg": run.ent_class},
                 role_dim={"s": run.ent_dim, "r": run.rel_dim,
@@ -722,6 +722,10 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--self_adv_temp", type=float, default=0.0,
                         help="self-adversarial negative weighting "
                              "temperature (RotatE eq. 5; 0 = off)")
+    parser.add_argument("--l2", type=float, default=0.0,
+                        help="lazy L2 on the positive triple's embedding "
+                             "rows (ComplEx-paper regularizer; 0 = the "
+                             "reference's unregularized loss)")
     parser.add_argument("--init_scheme", default="normal",
                         choices=["normal", "uniform"])
     parser.add_argument("--init_scale", type=float, default=0.1)

@@ -63,11 +63,20 @@ def _nll_loss(pos: jnp.ndarray, neg_s: jnp.ndarray, neg_o: jnp.ndarray,
     return (pos_l + neg_l).mean()
 
 
-def make_kge_loss(model: str = "complex", self_adv_temp: float = 0.0):
+def make_kge_loss(model: str = "complex", self_adv_temp: float = 0.0,
+                  l2: float = 0.0):
     """loss_fn for ops/fused.py. Roles: s, r, o [B, *]; neg [B, N] entity
     embeddings used to corrupt both the subject and the object side.
     `self_adv_temp` enables self-adversarial negative weighting (see
-    _nll_loss)."""
+    _nll_loss).
+
+    `l2` > 0 adds per-batch (lazy) L2 on the POSITIVE triple's embedding
+    rows — the ComplEx paper's regularizer, absent in the reference's
+    sigmoid-loss trainer (kge.cc :437-531) but load-bearing once train
+    coverage of the (s, r) pair space is sparse: unregularized NS-SGD
+    then memorizes train triples (loss falls) while test ranking stays
+    random (measured, docs/PERF.md 'Quality at 14.5k'). Lazy = only rows
+    touched by the step decay, which is exactly AdaGrad-compatible."""
     score = {"complex": complex_score, "rescal": rescal_score}[model]
 
     def loss_fn(embs, aux):
@@ -76,7 +85,11 @@ def make_kge_loss(model: str = "complex", self_adv_temp: float = 0.0):
         # corrupt subject and object with the same negative pool
         neg_s = score(neg, r[:, None, :], o[:, None, :])
         neg_o = score(s[:, None, :], r[:, None, :], neg)
-        return _nll_loss(pos, neg_s, neg_o, self_adv_temp)
+        loss = _nll_loss(pos, neg_s, neg_o, self_adv_temp)
+        if l2 > 0.0:
+            loss = loss + l2 * ((s * s).sum(-1) + (r * r).sum(-1)
+                                + (o * o).sum(-1)).mean()
+        return loss
 
     return loss_fn
 

@@ -270,6 +270,32 @@ def test_kge_lr_decay_beats_constant():
     assert decay["test_mrr"] > 1.2 * const["test_mrr"], (decay, const)
 
 
+@pytest.mark.slow
+@pytest.mark.skipif((__import__("os").cpu_count() or 1) < 4,
+                    reason="20-epoch dim-64 mid-scale run (~30+ CPU-min); "
+                           "needs a multi-core host for time")
+def test_kge_midscale_ceiling_fraction():
+    """Pinned CEILING FRACTION at mid scale (VERDICT r4 item 2's 'not
+    just 1.5x-uniform' bar): the round-5 recipe (dim 64 >= 4x the
+    generator's dim_truth, lr 0.7 x 0.93/epoch, freq + self-adv 3.0)
+    must reach >= 25% of the generating model's own filtered-MRR
+    ceiling on the 5k-entity lowrank harness in 20 epochs. Measured
+    0.150 / 0.340 = 44.1% at exactly this config (docs/PERF.md
+    'Breaking the plateau'); the floor leaves ~1.75x margin for seed
+    and scheduling noise."""
+    from adapm_tpu.apps import knowledge_graph_embeddings as kge
+    res = kge.run_app(kge.build_parser().parse_args(
+        ["--dim", "64", "--neg_ratio", "64",
+         "--synthetic_entities", "5000", "--synthetic_relations", "16",
+         "--synthetic_triples", "60000", "--synthetic_mode", "lowrank",
+         "--epochs", "20", "--batch_size", "1024", "--lr", "0.7",
+         "--lr_decay", "0.93", "--self_adv_temp", "3.0",
+         "--neg_sampling", "freq", "--eval_every", "20",
+         "--eval_triples", "500", "--num_shards", "2", "--seed", "0"]
+        + FAST))
+    assert res["test_mrr"] >= 0.25 * res["truth_mrr"], res
+
+
 def test_kge_checkpoint_resume(tmp_path):
     """Checkpoint -> resume (reference kge.cc checkpointing :327-401)."""
     from adapm_tpu.apps import knowledge_graph_embeddings as kge

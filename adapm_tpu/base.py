@@ -22,7 +22,8 @@ LOCAL = -1                            # op timestamp: answered entirely locally
 # Addressbook sentinels
 NOT_CACHED = -2                       # location cache: no cached location
 NO_SLOT = -1                          # key has no slot in a pool
-REMOTE = -1                           # owner: main copy lives on another process
+# owner sentinel: main copy lives on another process
+REMOTE = -1
 
 
 def check_key_range(keys, num_keys: int, what: str = "key") -> None:
@@ -40,7 +41,8 @@ def check_key_range(keys, num_keys: int, what: str = "key") -> None:
 class MgmtTechniques(enum.Enum):
     """Which adaptive management actions the planner may take.
 
-    Mirrors the reference `--sys.techniques {all,replication_only,relocation_only}`
+    Mirrors the reference `--sys.techniques
+    {all,replication_only,relocation_only}`
     (coloc_kv_server.h:209, sync_manager.h:624-644).
     """
 

@@ -21,8 +21,9 @@ class SystemOptions:
 
     # -- management techniques (sys.techniques)
     techniques: MgmtTechniques = MgmtTechniques.ALL
-    # -- channels (sys.channels): number of independent sync streams. On TPU the
-    #    sync program is a single fused collective per round; channels partition
+    # -- channels (sys.channels): number of independent sync streams.
+    #    On TPU the sync program is a single fused collective per round;
+    #    channels partition
     #    keys so each round can sync a subset (bounding per-round payload).
     channels: int = 4
     # -- location caches (sys.location_caches): keep per-host stale owner hints
@@ -85,7 +86,8 @@ class SystemOptions:
     # -- observability (sys.stats.*, sys.trace.*)
     stats_out: Optional[str] = None
     trace_keys: Optional[str] = None
-    locality_stats: bool = False     # per-key access counters (PS_LOCALITY_STATS)
+    # per-key access counters (PS_LOCALITY_STATS)
+    locality_stats: bool = False
     sync_report_s: float = 10.0      # periodic sync-thread report (0 = off)
 
     # -- sampling (--sampling.*)
@@ -98,12 +100,15 @@ class SystemOptions:
     @staticmethod
     def add_arguments(parser: argparse.ArgumentParser) -> None:
         g = parser.add_argument_group("system")
-        g.add_argument("--sys.techniques", dest="sys_techniques", default="all",
+        g.add_argument("--sys.techniques", dest="sys_techniques",
+                       default="all",
                        choices=[t.value for t in MgmtTechniques])
-        g.add_argument("--sys.channels", dest="sys_channels", type=int, default=4)
+        g.add_argument("--sys.channels", dest="sys_channels", type=int,
+                       default=4)
         g.add_argument("--sys.location_caches", dest="sys_location_caches",
                        type=int, default=1)
-        g.add_argument("--sys.time_intent_actions", dest="sys_time_intent_actions",
+        g.add_argument("--sys.time_intent_actions",
+                       dest="sys_time_intent_actions",
                        type=int, default=1)
         g.add_argument("--sys.heartbeat", dest="sys_heartbeat",
                        type=float, default=0.0)
@@ -132,16 +137,19 @@ class SystemOptions:
         g.add_argument("--sys.sync.report", dest="sys_sync_report",
                        type=float, default=10.0)
         s = parser.add_argument_group("sampling")
-        s.add_argument("--sampling.scheme", dest="sampling_scheme", default="local",
+        s.add_argument("--sampling.scheme", dest="sampling_scheme",
+                       default="local",
                        choices=["naive", "preloc", "pool", "local"])
         s.add_argument("--sampling.reuse", dest="sampling_reuse", type=int,
                        default=32)
-        s.add_argument("--sampling.pool_size", dest="sampling_pool_size", type=int,
+        s.add_argument("--sampling.pool_size", dest="sampling_pool_size",
+                       type=int,
                        default=0)
         s.add_argument("--sampling.batch_size", dest="sampling_batch_size",
                        type=int, default=1024)
         s.add_argument("--sampling.without_replacement",
-                       dest="sampling_without_replacement", action="store_true")
+                       dest="sampling_without_replacement",
+                       action="store_true")
 
     @classmethod
     def from_args(cls, args: argparse.Namespace) -> "SystemOptions":

@@ -234,7 +234,8 @@ class Addressbook:
         cs = self.cache_slot[shard, keys]
         assert (cs != NO_SLOT).all()
         cls = self.key_class[keys]
-        assert (cls == cls[0]).all(), "drop_replicas batch must be single-class"
+        assert (cls == cls[0]).all(), \
+            "drop_replicas batch must be single-class"
         self.cache_slot[shard, keys] = NO_SLOT
         self.replica_count[keys] -= 1
         self.cache_alloc[int(cls[0])].free_batch(shard, cs)

@@ -1281,7 +1281,8 @@ class Worker:
 
     def intent(self, keys, start: int, end: Optional[int] = None) -> None:
         """Declare future access to `keys` in clock window [start, end]
-        (reference Intent, coloc_kv_worker.h:380-408; end defaults to start)."""
+        (reference Intent, coloc_kv_worker.h:380-408; end defaults to
+        start)."""
         keys = np.unique(self._keys(keys))
         end = start if end is None else end
         self._intent_queue.push(keys, int(start), int(end))

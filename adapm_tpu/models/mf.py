@@ -1,4 +1,5 @@
-"""Matrix factorization with AdaGrad + L2 (reference apps/matrix_factorization.cc
+"""Matrix factorization with AdaGrad + L2 (reference
+apps/matrix_factorization.cc
 + apps/mf/update.h:23-79 `UpdateNsqlL2Adagrad`).
 
 Key layout (matrix_factorization.cc:692-697): row keys [0, first_col_key),

@@ -140,3 +140,63 @@ def test_setup_helper():
     w = s.make_worker()
     w.wait(w.push([0], np.ones(2, np.float32)))
     np.testing.assert_allclose(w.pull_sync([0]), 1.0)
+
+
+def test_optimistic_plan_revalidation(ctx):
+    """The optimistic-routing contract (core/kv.py _plan_pull/_plan_push,
+    reference per-key lock array handle.h:1069-1083): a plan computed
+    BEFORE a topology change must be discarded at the lock, not
+    dispatched with stale coordinates. The race is forced
+    deterministically: the planner relocates the key between the
+    worker's (hooked) plan phase and its dispatch."""
+    s = make_server(ctx)
+    assert s.opts.optimistic_routing
+    w0, w1 = s.make_worker(0), s.make_worker(1)
+    key = np.array([3], dtype=np.int64)
+    w0.wait(w0.set(key, np.full((1, 4), 7.0, np.float32)))
+
+    plans = {"n": 0}
+    orig = s._plan_pull
+
+    def racy_plan(keys, shard):
+        plan = orig(keys, shard)
+        if plans["n"] == 0:
+            plans["n"] += 1
+            # concurrent planner action lands after the plan was taken:
+            # move the key's main copy to another shard (bumps
+            # topology_version under the lock)
+            s._relocate([(int(key[0]), (shard + 1) % s.num_shards)])
+        else:
+            plans["n"] += 1
+        return plan
+
+    s._plan_pull = racy_plan
+    try:
+        got = w0.pull_sync(key)
+    finally:
+        s._plan_pull = orig
+    # the stale plan pointed at the old main slot (possibly freed);
+    # revalidation must re-plan and still read the authoritative value
+    np.testing.assert_allclose(got, 7.0)
+    assert plans["n"] >= 2, "stale plan was dispatched without re-plan"
+
+    # same for push: the stale plan's scatter coordinates must not leak
+    plans["n"] = 0
+    orig_push = s._plan_push
+
+    def racy_plan_push(keys, vals, shard, is_set=False):
+        plan = orig_push(keys, vals, shard, is_set=is_set)
+        if plans["n"] == 0:
+            plans["n"] += 1
+            s._relocate([(int(key[0]), (shard + 1) % s.num_shards)])
+        else:
+            plans["n"] += 1
+        return plan
+
+    s._plan_push = racy_plan_push
+    try:
+        w1.wait(w1.push(key, np.ones((1, 4), np.float32)))
+    finally:
+        s._plan_push = orig_push
+    assert plans["n"] >= 2
+    np.testing.assert_allclose(w0.pull_sync(key), 8.0)

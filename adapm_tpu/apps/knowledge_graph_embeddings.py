@@ -668,6 +668,11 @@ def run_app(args) -> dict:
         alog(f"[kge] TEST filtered MRR={result['test_mrr']:.4f} "
              f"(o={result['test_mrr_o']:.4f} s={result['test_mrr_s']:.4f}) "
              f"Hits@10={result['test_hits10']:.4f}")
+    # mean entity-row L2 norm: regularization evidence (--l2 must shrink
+    # it; tests/test_apps.py test_kge_l2_regularizer_shrinks_norms)
+    ent = srv.read_main(run.ekey(np.arange(min(run.E, 2048)))).reshape(
+        -1, 2 * run.ent_dim)[:, : run.ent_dim]
+    result["ent_norm"] = float(np.sqrt((ent * ent).sum(axis=1)).mean())
     alog("[kge]", srv.sync.report())
     srv.shutdown()
     return result

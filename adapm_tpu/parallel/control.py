@@ -46,8 +46,24 @@ def init_from_env() -> bool:
     if distributed.global_state.client is not None:
         return True  # already joined
     import jax
-    jax.distributed.initialize(coordinator_address=coord, num_processes=n,
-                               process_id=pid)
+    # ADAPM_COORD_HEARTBEAT_S (docs/env.md): coordination-service
+    # heartbeat timeout override. Unset = jax's own default (100 s in
+    # jax 0.9) — production dead-rank detection latency is unchanged.
+    # The mp TEST harness sets 300: on an oversubscribed CI host, N
+    # ranks x XLA compiles on 1-2 cores can stall a rank's heartbeat
+    # past 100 s, which surfaces as a CoordinationService PollForError
+    # on the OTHER ranks (observed flake in the mp app tests).
+    kw = {}
+    hb = int(round(float(os.environ.get("ADAPM_COORD_HEARTBEAT_S", "0"))))
+    if hb > 0:
+        kw["heartbeat_timeout_seconds"] = hb
+    try:
+        jax.distributed.initialize(coordinator_address=coord,
+                                   num_processes=n, process_id=pid, **kw)
+    except TypeError:
+        # older jax without the heartbeat kwarg: fall back to bare init
+        jax.distributed.initialize(coordinator_address=coord,
+                                   num_processes=n, process_id=pid)
     return True
 
 

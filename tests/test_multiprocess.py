@@ -31,6 +31,10 @@ def run_mp(n, scenario, devices=2, args=(), timeout=300):
                         " --xla_cpu_collective_call_warn_stuck_timeout_seconds=120 --xla_cpu_collective_call_terminate_timeout_seconds=900")
     # a hung scenario dumps its thread stacks + exits before our timeout
     env["ADAPM_FAULT_T"] = str(max(timeout - 20, 30))
+    # oversubscribed CI host: a rank's coordination heartbeat can stall
+    # past jax's 100 s default during concurrent XLA compiles and get
+    # declared dead (PollForError flake); raise it for tests only
+    env.setdefault("ADAPM_COORD_HEARTBEAT_S", "300")
     coordinator = f"localhost:{launcher.free_port()}"
     procs = [subprocess.Popen(
         [sys.executable, SCENARIOS, scenario, *map(str, args)],

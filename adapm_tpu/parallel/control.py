@@ -97,9 +97,7 @@ def barrier(name: str = "adapm") -> None:
     if client is not None:
         # id allocation is atomic; the wait happens outside the lock so
         # concurrent barriers from different threads both make progress
-        with _barrier_lock:
-            _barrier_seq += 1
-            seq = _barrier_seq
+        seq = _next_seq("barrier")
         # generous timeout: a peer may be inside a cold XLA compile
         client.wait_at_barrier(f"adapm/{name}/{seq}", 600_000)
         return
@@ -172,32 +170,110 @@ def dead_processes(max_age_s: float = 10.0) -> list:
     return dead
 
 
+_kv_seq = 0
+
+
+def _next_seq(counter: str) -> int:
+    """Allocate the next per-primitive sequence number (shared allocator
+    for barrier and KV gather/broadcast ids; both contracts already
+    require identical call order on every process)."""
+    global _kv_seq, _barrier_seq
+    with _barrier_lock:
+        if counter == "barrier":
+            _barrier_seq += 1
+            return _barrier_seq
+        _kv_seq += 1
+        return _kv_seq
+
+
+def _kv_gather(tag: str, payload: bytes, timeout_ms: int = 600_000):
+    """Publish this rank's payload under a fresh sequence id and collect
+    every rank's, via the coordinator KV store. HOST-ONLY on purpose: a
+    device collective here can deadlock the PM — a rank parked inside
+    the collective holds its device queue, its DCN serve threads then
+    cannot dispatch the gather a PEER's in-flight read needs, and that
+    peer never reaches the collective (observed: guard.expired()'s
+    allreduce vs a peer still inside the chunked eval's filter
+    correction). The control plane must ride the control plane
+    (reference: ps_allreduce goes through the PS/scheduler, never the
+    data path — include/utils.h:163-197).
+
+    Callers must invoke in the same ORDER on every process (same
+    contract as barrier()). Keys are deleted after a trailing barrier so
+    the store does not grow with call count. Requires the coordination
+    client (callers fall back to multihost_utils without one — e.g.
+    multi-host TPU auto-topology launched outside the ADAPM env)."""
+    import base64
+    import jax
+    from jax._src import distributed
+    client = distributed.global_state.client
+    seq = _next_seq("kv")
+    pid = jax.process_index()
+    key = f"adapm/{tag}/{seq}"
+    client.key_value_set(f"{key}/{pid}", base64.b64encode(payload).decode())
+    parts = []
+    for p in range(jax.process_count()):
+        s = client.blocking_key_value_get(f"{key}/{p}", timeout_ms)
+        parts.append(base64.b64decode(s))
+    # all ranks have read everything once all have passed this barrier;
+    # deleting one's own key is then race-free
+    barrier(f"{tag}-gc")
+    client.key_value_delete(f"{key}/{pid}")
+    return parts
+
+
+def _kv_client():
+    from jax._src import distributed
+    return distributed.global_state.client
+
+
 def allreduce(values, op: str = "sum") -> np.ndarray:
     """Sum/mean/max a host scalar or vector across processes (reference
     ps_allreduce, include/utils.h:163-197: push to a shared PS key, barrier,
-    pull). Single-process: returns the input unchanged (as float64 array)."""
+    pull). Single-process: returns the input unchanged (as float64 array).
+    Rides the coordinator KV store — never a device collective (see
+    _kv_gather for why that would deadlock)."""
     import jax
     if op not in ("sum", "mean", "max"):
         raise ValueError(f"unknown allreduce op {op}")
     arr = np.atleast_1d(np.asarray(values, dtype=np.float64))
     if jax.process_count() == 1:
         return arr
-    from jax.experimental import multihost_utils
-    gathered = np.asarray(multihost_utils.process_allgather(arr))  # [P, ...]
+    if _kv_client() is None:  # no coordination service: last resort only
+        from jax.experimental import multihost_utils
+        gathered = np.asarray(multihost_utils.process_allgather(arr))
+    else:
+        parts = _kv_gather("ar", arr.tobytes())
+        gathered = np.stack([np.frombuffer(b, dtype=np.float64).reshape(
+            arr.shape) for b in parts])
     return {"sum": gathered.sum, "mean": gathered.mean,
             "max": gathered.max}[op](axis=0)
 
 
 def broadcast(values, root: int = 0) -> np.ndarray:
     """Broadcast a host array from `root` to all processes (worker-0
-    initialization across hosts)."""
+    initialization across hosts). KV-store transport, same rationale as
+    allreduce; one root-published key, O(P) coordinator messages."""
+    import base64
     import jax
     arr = np.asarray(values)
     if jax.process_count() == 1:
         return arr
-    from jax.experimental import multihost_utils
-    return np.asarray(multihost_utils.broadcast_one_to_all(
-        arr, is_source=jax.process_index() == root))
+    client = _kv_client()
+    if client is None:  # no coordination service: last resort only
+        from jax.experimental import multihost_utils
+        return np.asarray(multihost_utils.broadcast_one_to_all(
+            arr, is_source=jax.process_index() == root)).copy()
+    seq = _next_seq("kv")
+    key = f"adapm/bc/{seq}"
+    if jax.process_index() == root:
+        client.key_value_set(key, base64.b64encode(arr.tobytes()).decode())
+    raw = base64.b64decode(client.blocking_key_value_get(key, 600_000))
+    barrier("bc-gc")
+    if jax.process_index() == root:
+        client.key_value_delete(key)
+    # .copy(): frombuffer over bytes is read-only; callers may mutate
+    return np.frombuffer(raw, dtype=arr.dtype).reshape(arr.shape).copy()
 
 
 # NOTE: an earlier draft exposed intent_summary_allgather here for a

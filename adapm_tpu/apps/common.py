@@ -156,13 +156,14 @@ class ScanWindow:
             self.on_loss(runner.run_scan(
                 [r for _, r, _ in self.buf],
                 [a for _, _, a in self.buf] if has_aux else None, lr))
-            for _ in range(len(self.buf) * self.rounds):
-                self.server.sync.run_round()
+            # drive_rounds: inline planner rounds, or delegated to the
+            # prefetch pipeline's background thread (SystemOptions
+            # .prefetch) so they overlap the in-flight scan window
+            self.server.drive_rounds(len(self.buf) * self.rounds)
         else:
             for rn, roles, aux in self.buf:
                 self.on_loss(rn(roles, aux, lr))
-                for _ in range(self.rounds):
-                    self.server.sync.run_round()
+                self.server.drive_rounds(self.rounds)
         self.buf.clear()
 
 

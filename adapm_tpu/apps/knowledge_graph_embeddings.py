@@ -413,7 +413,8 @@ def _evaluate_pool_mp(run: KgeRun, triples: np.ndarray, batch: int):
     # (same pattern as parallel/collective.py's first-exchange barrier).
     control.barrier("adapm-eval-merge")
     gg = control.allreduce(
-        np.concatenate([G_o, G_s]).astype(np.float64), "sum")
+        np.concatenate([G_o, G_s]).astype(np.float64), "sum",
+        site="eval-merge")
     G_o = gg[:T].astype(np.int64)
     G_s = gg[T:].astype(np.int64)
 
@@ -551,7 +552,15 @@ def run_app(args) -> dict:
             batches = [mine[idx] for idx in
                        wrap_batches(len(mine), B, rng)]
             handles = {}
+            staged = {}  # bi -> (roles, StagedKeys) pre-uploaded batches
             prepared_hi = -1  # highest batch index already prepared
+
+            def triple_roles(t):
+                # the ONE logical->physical role mapping for a triple
+                # batch (prepare, staged-miss fallback, and host path
+                # must all agree)
+                return {"s": run.ekey(t[:, 0]), "r": run.rkey(t[:, 1]),
+                        "o": run.ekey(t[:, 2])}
 
             def prepare(bi: int, ahead: int) -> None:
                 # the scan-window loop prepares up to lo+look+K ahead; the
@@ -562,13 +571,19 @@ def run_app(args) -> dict:
                     return
                 prepared_hi = bi
                 t = train[batches[bi]]
+                roles = triple_roles(t)
                 ks = np.unique(np.concatenate(
-                    [run.ekey(t[:, 0]), run.rkey(t[:, 1]),
-                     run.ekey(t[:, 2])]))
+                    [roles["s"], roles["r"], roles["o"]]))
                 fut = w.current_clock + ahead
                 w.intent(ks, fut, fut + 1)
                 if not args.device_routes:
                     handles[bi] = w.prepare_sample(B * N, fut, fut + 1)
+                elif srv.prefetch is not None and K == 1:
+                    # prefetch pipeline on: the batch's key upload rides
+                    # the prepare path (DeviceRoutedRunner.prefetch_keys)
+                    # instead of the dispatch critical section
+                    staged[bi] = (roles, device_runner(w.shard)
+                                  .prefetch_keys(roles))
 
             K = max(1, args.scan_steps) if args.device_routes else 1
             for bi in range(min(max(args.lookahead, K), len(batches))):
@@ -585,14 +600,11 @@ def run_app(args) -> dict:
                                     min(lo + look + K, len(batches))):
                         prepare(bi, ahead=bi - lo)
                     window = [train[batches[lo + j]] for j in range(K)]
-                    roles = [{"s": run.ekey(t[:, 0]),
-                              "r": run.rkey(t[:, 1]),
-                              "o": run.ekey(t[:, 2])} for t in window]
+                    roles = [triple_roles(t) for t in window]
                     epoch_losses.append(
                         device_runner(w.shard).run_scan(
                             roles, None, lr_epoch))
-                    for _ in range(K * args.sync_rounds_per_step):
-                        srv.sync.run_round()
+                    srv.drive_rounds(K * args.sync_rounds_per_step)
                     for _ in range(K):
                         w.advance_clock()
                 tail_start = len(batches) - len(batches) % K
@@ -602,13 +614,17 @@ def run_app(args) -> dict:
                 idx = batches[bi]
                 if bi + args.lookahead < len(batches):
                     prepare(bi + args.lookahead, ahead=args.lookahead)
-                t = train[idx]
-                roles = {"s": run.ekey(t[:, 0]), "r": run.rkey(t[:, 1]),
-                         "o": run.ekey(t[:, 2])}
                 if args.device_routes:
-                    loss = device_runner(w.shard)(roles, None,
-                                                  lr_epoch)
+                    pre = staged.pop(bi, None)
+                    if pre is not None:  # keys already on device
+                        roles, stg = pre
+                        loss = device_runner(w.shard)(roles, None,
+                                                      lr_epoch, staged=stg)
+                    else:
+                        loss = device_runner(w.shard)(
+                            triple_roles(train[idx]), None, lr_epoch)
                 else:
+                    roles = triple_roles(train[idx])
                     neg = np.asarray(
                         w.pull_sample_keys(handles[bi], B * N)).reshape(B, N)
                     w.finish_sample(handles.pop(bi))
@@ -616,8 +632,7 @@ def run_app(args) -> dict:
                     loss = run.runner(roles, None, lr_epoch,
                                       shard=w.shard)
                 epoch_losses.append(loss)
-                for _ in range(args.sync_rounds_per_step):
-                    srv.sync.run_round()
+                srv.drive_rounds(args.sync_rounds_per_step)
                 w.advance_clock()
         srv.quiesce()
 

@@ -128,8 +128,9 @@ def run(args) -> float:
             loss = device_runner(w.shard)(roles, np.asarray(vals[idx]), lr)
         else:
             loss = runner(roles, np.asarray(vals[idx]), lr, shard=w.shard)
-        for _ in range(args.sync_rounds_per_step):
-            srv.sync.run_round()
+        # inline rounds, or delegated to the prefetch pipeline so
+        # planner work overlaps the in-flight step
+        srv.drive_rounds(args.sync_rounds_per_step)
         w.advance_clock()
         return loss
 

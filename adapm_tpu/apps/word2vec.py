@@ -198,8 +198,9 @@ def run(args) -> float:
                     else:
                         losses.append(step(cc[:B], xx[:B],
                                            None if nn is None else nn[:B]))
-                        for _ in range(args.sync_rounds_per_step):
-                            srv.sync.run_round()
+                        # inline rounds, or delegated to the prefetch
+                        # pipeline so planner work overlaps the step
+                        srv.drive_rounds(args.sync_rounds_per_step)
                     buf_c, buf_x = [cc[B:]], [xx[B:]]
                     buf_n = [] if nn is None else [nn[B:]]
                     n_buf -= B

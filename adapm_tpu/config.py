@@ -70,6 +70,26 @@ class SystemOptions:
     #    threads scale on multi-core hosts; off = route under the lock.
     optimistic_routing: bool = True
 
+    # -- prefetch pipeline (sys.prefetch.*; core/intent.py
+    #    PrefetchScheduler): consume Worker.intent declarations on a
+    #    background thread — delegated planner rounds, staged device
+    #    table mirrors, and pre-gathered pull buffers — so the training
+    #    thread's per-step critical path is the device dispatch alone.
+    #    Default on; --sys.prefetch 0 is the kill switch (everything
+    #    then runs inline, the pre-r6 behavior).
+    prefetch: bool = True
+    # staged pull batches kept per worker (oldest evicted beyond this)
+    prefetch_max_batches: int = 4
+    # device rows the staging pool may hold per length class (bounds the
+    # HBM the pipeline can pin; 65536 rows of 512 f32 = 128 MiB)
+    prefetch_staging_rows: int = 65536
+    # when to pre-gather pull buffers: "auto" stages only for workers
+    # that use the Pull API (fused-runner loops never pull — staging
+    # gathers for them is wasted device work), "always"/"off" force it
+    prefetch_pull: str = "auto"
+    # routing-plan cache entries (core/intent.py PlanCache; 0 = off)
+    plan_cache_entries: int = 64
+
     # -- ActionTimer (sys.timing.*; reference sync_manager.h:62-158)
     timing_alpha: float = 0.1
     timing_quantile: float = 0.9999
@@ -130,6 +150,17 @@ class SystemOptions:
                        type=float, default=1.25)
         g.add_argument("--sys.optimistic_routing",
                        dest="sys_optimistic_routing", type=int, default=1)
+        g.add_argument("--sys.prefetch", dest="sys_prefetch", type=int,
+                       default=1)
+        g.add_argument("--sys.prefetch.max_batches",
+                       dest="sys_prefetch_max_batches", type=int, default=4)
+        g.add_argument("--sys.prefetch.staging_rows",
+                       dest="sys_prefetch_staging_rows", type=int,
+                       default=65536)
+        g.add_argument("--sys.prefetch.pull", dest="sys_prefetch_pull",
+                       default="auto", choices=["auto", "always", "off"])
+        g.add_argument("--sys.plan_cache", dest="sys_plan_cache", type=int,
+                       default=64)
         g.add_argument("--sys.stats.out", dest="sys_stats_out", default=None)
         g.add_argument("--sys.trace.keys", dest="sys_trace_keys", default=None)
         g.add_argument("--sys.stats.locality", dest="sys_stats_locality",
@@ -168,6 +199,11 @@ class SystemOptions:
             collective_cadence=args.sys_collective_cadence,
             main_over_alloc=args.sys_main_over_alloc,
             optimistic_routing=bool(args.sys_optimistic_routing),
+            prefetch=bool(args.sys_prefetch),
+            prefetch_max_batches=args.sys_prefetch_max_batches,
+            prefetch_staging_rows=args.sys_prefetch_staging_rows,
+            prefetch_pull=args.sys_prefetch_pull,
+            plan_cache_entries=args.sys_plan_cache,
             stats_out=args.sys_stats_out,
             trace_keys=args.sys_trace_keys,
             locality_stats=args.sys_stats_locality,

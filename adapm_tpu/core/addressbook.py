@@ -139,6 +139,11 @@ class Addressbook:
         # bumped on every ownership move; rejects stale location info in the
         # multi-host control plane (reference addressbook.h:92-102)
         self.relocation_counter = np.zeros(num_keys, dtype=np.int32)
+        # counted placement mutations (replica add/drop, relocation,
+        # adopt/abandon) — paired with topology_version bumps by
+        # Server._topology_mutation's discipline assertion; the initial
+        # allocation below is construction, not a mutation
+        self.mutations = 0
 
         self.main_alloc = [SlotAllocator(num_shards, m) for m in main_slots]
         self.cache_alloc = [SlotAllocator(num_shards, c) for c in cache_slots]
@@ -217,6 +222,8 @@ class Addressbook:
         alloc = self.cache_alloc[int(cls[0])]
         cs = alloc.alloc_batch(shard, len(keys))
         taken = keys[: len(cs)]
+        if len(taken):
+            self.mutations += 1
         self.cache_slot[shard, taken] = cs
         self.replica_count[taken] += 1
         return cs
@@ -236,6 +243,7 @@ class Addressbook:
         cls = self.key_class[keys]
         assert (cls == cls[0]).all(), \
             "drop_replicas batch must be single-class"
+        self.mutations += 1
         self.cache_slot[shard, keys] = NO_SLOT
         self.replica_count[keys] -= 1
         self.cache_alloc[int(cls[0])].free_batch(shard, cs)
@@ -250,6 +258,7 @@ class Addressbook:
         assert old_shard != new_shard
         alloc = self.main_alloc[self.key_class[key]]
         new_slot = alloc.alloc(new_shard)
+        self.mutations += 1
         self.owner[key] = new_shard
         self.slot[key] = new_slot
         alloc.free(old_shard, old_slot)
@@ -290,6 +299,7 @@ class Addressbook:
             raise RuntimeError(
                 f"process out of main pool slots while adopting "
                 f"{len(keys) - pos} relocated keys; increase over_alloc")
+        self.mutations += 1
         self.owner[keys] = sh_out
         self.slot[keys] = sl_out
         self.relocation_counter[keys] += 1
@@ -307,6 +317,7 @@ class Addressbook:
         sl = self.slot[keys]
         assert (sh >= 0).all(), "abandon_batch keys must be locally owned"
         alloc = self.main_alloc[int(cls[0])]
+        self.mutations += 1
         for s in np.unique(sh):
             alloc.free_batch(int(s), sl[sh == s])
         self.owner[keys] = REMOTE
@@ -326,6 +337,8 @@ class Addressbook:
         alloc = self.main_alloc[int(cls[0])]
         new_slots = alloc.alloc_batch(new_shard, len(keys))
         moved = keys[: len(new_slots)]
+        if len(moved):
+            self.mutations += 1
         old_shards = self.owner[moved].astype(np.int64)
         old_slots = self.slot[moved].astype(np.int64)
         assert (old_shards != new_shard).all()

@@ -14,11 +14,13 @@ the Poisson quantile, which the reference also falls back to for large means).
 """
 from __future__ import annotations
 
+import collections
 import heapq
 import itertools
 import math
+import threading
 import time
-from typing import List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -94,6 +96,512 @@ class ActionTimer:
             self._rate * self._round_secs * self.rounds_lookahead, 1.0)
         w = np.ceil(mean + self.z * np.sqrt(mean)).astype(np.int64)
         return np.maximum(w, 1)
+
+
+class PlanCache:
+    """Routing-plan cache for the hot Pull/Push path.
+
+    Keyed by (kind, shard, fingerprint-of-keys) and guarded by the
+    server's `topology_version`: a plan is a pure function of the key
+    batch and the addressbook tables, and every table mutation bumps the
+    version as the last step of its critical section
+    (Server._topology_mutation), so version-match == plan-valid — the
+    same revalidation contract optimistic routing already relies on. The
+    fingerprint is a content hash of the key bytes; the stored key array
+    is compared exactly on lookup, so a hash collision degrades to a
+    cache miss, never to a wrong plan.
+
+    Every training loop replays the same batch *arrays* on two paths:
+    the prefetch pipeline plans a batch at intent time and `pull` replans
+    it at consume time (or after a write invalidated the staged values —
+    writes invalidate staged VALUE buffers, not plans), and benches/test
+    harnesses rotate a fixed batch set. Both skip `_plan_pull`/
+    `_plan_push` entirely on a hit.
+
+    Thread-safe: the prefetch thread and worker threads share it.
+    """
+
+    def __init__(self, max_entries: int = 64):
+        self.max_entries = max_entries
+        # (kind, shard, fp) -> (keys, topology_version, plan); insertion
+        # order doubles as the LRU order
+        self._entries: "collections.OrderedDict" = collections.OrderedDict()
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+        self.stale = 0
+
+    @staticmethod
+    def fingerprint(keys: np.ndarray) -> int:
+        # siphash over the raw bytes; collisions are caught by the exact
+        # compare in get()
+        return hash(keys.tobytes())
+
+    def get(self, kind: str, shard: int, keys: np.ndarray, version: int):
+        if self.max_entries <= 0:
+            return None
+        k = (kind, shard, self.fingerprint(keys))
+        with self._lock:
+            ent = self._entries.get(k)
+            if ent is None:
+                self.misses += 1
+                return None
+            k0, v0, plan = ent
+            if v0 != version:
+                self.stale += 1
+                del self._entries[k]
+                return None
+            if k0.shape != keys.shape or not np.array_equal(k0, keys):
+                self.misses += 1  # fingerprint collision: treat as miss
+                return None
+            self.hits += 1
+            self._entries.move_to_end(k)
+            return plan
+
+    def put(self, kind: str, shard: int, keys: np.ndarray, version: int,
+            plan) -> None:
+        if self.max_entries <= 0:
+            return
+        k = (kind, shard, self.fingerprint(keys))
+        with self._lock:
+            self._entries[k] = (keys.copy(), version, plan)
+            self._entries.move_to_end(k)
+            while len(self._entries) > self.max_entries:
+                self._entries.popitem(last=False)
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {"entries": len(self._entries), "hits": self.hits,
+                    "misses": self.misses, "stale": self.stale}
+
+
+class _StagingAbort(Exception):
+    """Internal: a staging attempt hit its pool budget (not an error)."""
+
+
+class _StagedPull:
+    """One pre-gathered pull batch: the device value buffers plus the
+    bookkeeping to decide, at consume time, whether they are still the
+    values a fresh pull would return."""
+
+    __slots__ = ("keys", "fp", "version", "groups", "n_remote",
+                 "worker_id", "end", "acquired")
+
+    def __init__(self, keys, fp, version, groups, n_remote, worker_id,
+                 end, acquired):
+        self.keys = keys            # the intended (unique, sorted) batch
+        self.fp = fp
+        self.version = version      # topology_version at gather time
+        self.groups = groups        # Server._pull-shaped per-class groups
+        self.n_remote = n_remote
+        self.worker_id = worker_id
+        self.end = end              # intent end clock (expiry)
+        self.acquired = acquired    # [(StagingPool, rows)] to release
+
+
+class PrefetchScheduler:
+    """Intent-driven prefetch pipeline: the declared-intent lookahead of
+    the reference (coloc_kv_worker.h Intent -> sync-manager action),
+    extended to stage the *data plane* ahead of the access (SURVEY §2.5
+    "pipeline-style lookahead"; NestPipe's embedding-prefetch overlap).
+
+    One background thread consumes `Worker.intent` declarations and, for
+    intents whose start clock falls inside the ActionTimer window:
+
+      1. drives planner rounds delegated via `pump()` — the per-step
+         `sync.run_round` moves off the training thread, so relocations,
+         replica churn and the table re-uploads they trigger overlap the
+         in-flight device step instead of serializing after it;
+      2. refreshes registered device-side consumers (DeviceRouter table
+         mirrors, local sampling indexes — `register_refresher`) as soon
+         as the topology settles, so the next dispatch finds them staged;
+      3. pre-gathers intended pull batches into device-resident staged
+         buffers (ShardedStore.stage_gather) so `Worker.pull` of an
+         intended batch is a staged-buffer hit: no re-planning, no
+         `Server._lock`, no dispatch on the consuming thread.
+
+    Consistency: a staged batch records the `topology_version` it was
+    gathered under; any topology mutation invalidates it lazily at take
+    time (relocation may fold a stale replica base into the moved row,
+    so even value-preserving-looking moves are not trusted). Value
+    writes are tracked eagerly: every server-side write path calls
+    `note_writes(keys)` under the server lock, and staged batches
+    intersecting the written keys are dropped and re-staged in the
+    background — a pull can therefore never observe a staged buffer
+    gathered before an overlapping write (read-your-writes), and a
+    staged hit is bit-identical to the pull it replaced.
+
+    Pull staging is gated by `opts.prefetch_pull`: "auto" stages only
+    for workers that actually use the Pull API (fused-runner loops never
+    pull, and staging gathers for them would be wasted device work),
+    "always"/"off" force it. Staged-buffer memory is bounded by a
+    per-class StagingPool (opts.prefetch_staging_rows) and
+    opts.prefetch_max_batches per worker.
+    """
+
+    def __init__(self, server, opts):
+        self.server = server
+        self.opts = opts
+        self._cond = threading.Condition()
+        self._thread: Optional[threading.Thread] = None
+        self._stop = False
+        self._busy = False
+        self._rounds = 0            # delegated planner rounds (capped)
+        self._sweep = False         # explicit expiry/deferred sweep request
+        self._pending: List[tuple] = []   # (worker, keys, start, end)
+        self._deferred: List[tuple] = []  # beyond the ActionTimer window
+        self._restage: List[tuple] = []   # invalidated, still in window
+        # staged entries + an O(1)-per-key membership mask for the write
+        # intersection test (allocated lazily: it is num_keys ints)
+        self._plock = threading.Lock()
+        self._staged: Dict[tuple, _StagedPull] = {}
+        self._mask: Optional[np.ndarray] = None
+        self._refreshers: List = []
+        from .store import StagingPool
+        self.pools = [StagingPool(opts.prefetch_staging_rows)
+                      for _ in server.stores]
+        self.stats = {"staged": 0, "hits": 0, "expired": 0,
+                      "invalidated_write": 0, "invalidated_topology": 0,
+                      "restaged": 0, "rounds_driven": 0, "pool_full": 0,
+                      "evicted": 0}
+
+    # -- producer side (training threads) -----------------------------------
+
+    def on_intent(self, worker, keys: np.ndarray, start: int,
+                  end: int) -> None:
+        """Called by Worker.intent (keys already unique+sorted). Queues
+        the batch for background staging; placement actions themselves
+        stay with the planner rounds (inline or delegated via pump)."""
+        if not self._should_stage(worker):
+            return
+        with self._cond:
+            self._pending.append((worker, keys, start, end))
+            # bound the backlog: a producer outrunning the stager keeps
+            # only the freshest window of batches
+            limit = 2 * max(1, self.opts.prefetch_max_batches)
+            if len(self._pending) > limit:
+                del self._pending[: len(self._pending) - limit]
+            self._kick_locked()
+
+    def pump(self, rounds: int = 1) -> None:
+        """Delegate `rounds` planner rounds to the background thread (the
+        apps' per-step `run_round` slot). Backlogged rounds coalesce: a
+        single request may ask for a full scan window's rounds, but when
+        the training thread outruns the planner the backlog stays
+        bounded — each round drains ALL window-eligible intents anyway,
+        so coalesced rounds batch the same planner work into fewer,
+        larger drains (the reference's background sync managers run at
+        their own cadence the same way)."""
+        with self._cond:
+            # bound accumulation at the LARGEST pending request (floor
+            # 2): a scan window's drive_rounds(K) stands even if a
+            # smaller per-step pump (or a pump(0) sweep) follows before
+            # the thread swaps the backlog out
+            self._rounds = min(self._rounds + rounds,
+                               max(self._rounds, rounds, 2))
+            self._sweep = True  # pump(0) = expiry/deferred sweep only
+            self._kick_locked()
+
+    def register_refresher(self, fn) -> None:
+        """Register a callable refreshed by the pipeline after planner
+        rounds (called under the server lock): device table mirrors,
+        local sampling indexes. Idempotent callables only. Bound methods
+        are held WEAKLY: a runner that goes away stops being refreshed
+        (and stops pinning its device mirrors) instead of leaking into
+        every future round."""
+        import weakref
+        try:
+            ref = weakref.WeakMethod(fn)
+        except TypeError:
+            def ref(f=fn):  # plain function: keep a strong reference
+                return f
+        self._refreshers.append(ref)
+
+    # -- consumer side (Worker.pull fast path) ------------------------------
+
+    def take_staged(self, worker, keys: np.ndarray) -> Optional[_StagedPull]:
+        """Pop a valid staged batch for `keys`, or None. Lock-free with
+        respect to the server lock — this IS the fast path."""
+        if not self._staged:
+            return None
+        fp = PlanCache.fingerprint(keys)
+        with self._plock:
+            e = self._staged.pop((worker.worker_id, fp), None)
+            if e is None:
+                return None
+            self._mask_sub(e.keys)
+            self._release(e)
+        if e.keys.shape != keys.shape or not np.array_equal(e.keys, keys):
+            return None  # fingerprint collision
+        if e.version != self.server.topology_version:
+            # placement moved since the gather (e.g. a relocation folded
+            # a stale replica base into the moved row): not trusted
+            self.stats["invalidated_topology"] += 1
+            return None
+        self.stats["hits"] += 1
+        return e
+
+    # -- invalidation (server write paths; caller holds the server lock) ----
+
+    def note_writes(self, keys: np.ndarray) -> None:
+        """Drop (and queue for re-staging) staged batches intersecting
+        `keys`. Called from every value-write path BEFORE the write could
+        be observed missing: push/set scatter, cross-process applies,
+        replica sync refreshes."""
+        if not self._staged or self._mask is None:
+            return
+        restage = []
+        with self._plock:
+            if not self._staged:
+                return
+            flat = keys.reshape(-1)
+            if not self._mask[flat].any():
+                return
+            for k, e in list(self._staged.items()):
+                if np.isin(e.keys, flat, assume_unique=False).any():
+                    del self._staged[k]
+                    self._mask_sub(e.keys)
+                    self._release(e)
+                    self.stats["invalidated_write"] += 1
+                    restage.append(e)
+        if restage:
+            with self._cond:
+                for e in restage:
+                    w = self.server._workers.get(e.worker_id)
+                    if w is not None and e.end >= w.current_clock:
+                        self._restage.append((w, e.keys, 0, e.end))
+                self._kick_locked()
+
+    def invalidate_all(self) -> None:
+        with self._plock:
+            for e in self._staged.values():
+                self._mask_sub(e.keys)
+                self._release(e)
+            self._staged.clear()
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def flush(self, timeout: float = 60.0) -> None:
+        """Block until the pipeline is idle (tests / quiesce)."""
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            while (self._busy or self._rounds or self._pending
+                   or self._restage or self._sweep):
+                if not self._cond.wait(timeout=min(
+                        0.5, max(0.0, deadline - time.monotonic()))):
+                    if time.monotonic() >= deadline:
+                        raise TimeoutError("prefetch pipeline flush")
+
+    def close(self) -> None:
+        with self._cond:
+            self._stop = True
+            self._cond.notify_all()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=30)
+        self.invalidate_all()
+
+    # -- internals -----------------------------------------------------------
+
+    def _should_stage(self, worker) -> bool:
+        mode = self.opts.prefetch_pull
+        if mode == "off":
+            return False
+        # auto: fused-runner loops never Pull — staging gathers for them
+        # is wasted device work. A worker that has pulled is a Pull user.
+        return mode == "always" or worker.stats["pull_ops"] > 0
+
+    def _kick_locked(self) -> None:
+        if self._thread is None and not self._stop:
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True, name="adapm-prefetch")
+            self._thread.start()
+        self._cond.notify_all()
+
+    def _mask_add(self, keys: np.ndarray) -> None:
+        if self._mask is None:
+            self._mask = np.zeros(self.server.num_keys, dtype=np.int32)
+        self._mask[keys] += 1
+
+    def _mask_sub(self, keys: np.ndarray) -> None:
+        if self._mask is not None:
+            self._mask[keys] -= 1
+
+    def _release(self, e: _StagedPull) -> None:
+        for pool, rows in e.acquired:
+            pool.release(rows)
+        e.acquired = []
+
+    def _loop(self) -> None:
+        from ..utils import alog
+        srv = self.server
+        while True:
+            with self._cond:
+                while not (self._stop or self._rounds or self._pending
+                           or self._restage or self._sweep):
+                    self._busy = False
+                    self._cond.notify_all()
+                    # finite wait only while deferred intents may enter
+                    # the window as clocks advance (coarse: a deferred
+                    # intent is by definition not imminent, and an idle
+                    # server with a parked far-future intent should not
+                    # be woken 20x a second)
+                    self._cond.wait(0.25 if self._deferred else None)
+                    if self._deferred:
+                        break
+                if self._stop:
+                    self._busy = False
+                    self._cond.notify_all()
+                    return
+                self._busy = True
+                self._sweep = False
+                rounds, self._rounds = self._rounds, 0
+                pending, self._pending = self._pending, []
+                restage, self._restage = self._restage, []
+            try:
+                for _ in range(rounds):
+                    srv.sync.run_round()
+                    self.stats["rounds_driven"] += 1
+                if rounds:
+                    self._refresh_consumers()
+                self._expire()
+                from ..base import WORKER_FINISHED
+                now_deferred = []
+                for item in self._deferred + pending:
+                    w, keys, start, end = item
+                    # a finalized worker never pulls again — its parked
+                    # intents (even CLOCK_MAX ones) must not keep the
+                    # deferred poll alive
+                    if end < w.current_clock or \
+                            w.current_clock == WORKER_FINISHED:
+                        self.stats["expired"] += 1
+                        continue
+                    window = int(srv.sync.timer.window()[w.worker_id])
+                    if start > w.current_clock + window:
+                        now_deferred.append(item)
+                        continue
+                    self._stage_one(w, keys, end)
+                self._deferred = now_deferred
+                for w, keys, _, end in restage:
+                    if end >= w.current_clock:
+                        # record=False: the original staging already
+                        # counted this batch in the locality stats; a
+                        # write-invalidation restage must not count the
+                        # same eventual pull twice
+                        if self._stage_one(w, keys, end, record=False):
+                            self.stats["restaged"] += 1
+            except Exception as e:  # noqa: BLE001 — keep the pipeline up
+                alog(f"[prefetch] background task failed: "
+                     f"{type(e).__name__}: {e}")
+
+    def _refresh_consumers(self) -> None:
+        if not self._refreshers:
+            return
+        with self.server._lock:
+            live = []
+            for ref in self._refreshers:
+                fn = ref()
+                if fn is not None:  # consumer still alive
+                    fn()
+                    live.append(ref)
+            self._refreshers = live
+
+    def _expire(self) -> None:
+        """Drop staged batches whose intent window has passed."""
+        if not self._staged:
+            return
+        with self._plock:
+            for k, e in list(self._staged.items()):
+                w = self.server._workers.get(e.worker_id)
+                if w is None or e.end < w.current_clock:
+                    del self._staged[k]
+                    self._mask_sub(e.keys)
+                    self._release(e)
+                    self.stats["expired"] += 1
+
+    def _stage_one(self, worker, keys: np.ndarray, end: int,
+                   record: bool = True) -> bool:
+        """Plan (through the plan cache) and pre-gather one intended
+        batch; returns True when a staged entry was recorded. `record`
+        gates the locality-stats record (False on restage — the first
+        staging already counted the batch)."""
+        srv = self.server
+        if len(keys) == 0:
+            return False
+        from .store import OOB
+        shard = worker.shard
+        tv = srv.topology_version
+        plan = srv._plan_cached("pull", shard, keys, tv,
+                                lambda: srv._plan_pull(keys, shard))
+        rem, loc_map, cls = plan
+        if rem is not None:
+            return False  # process-remote keys: normal pull path handles
+        fp = PlanCache.fingerprint(keys)
+        acquired = []
+        groups = []
+        n_remote = 0
+        with srv._lock:
+            if srv.topology_version != tv:
+                return False  # placement moved mid-plan: retry next round
+            try:
+                for cid, pos, ks, (o_sh, o_sl, c_sh, c_sl, use_c, nr,
+                                   local) in cls:
+                    out = srv.stores[cid].stage_gather(
+                        o_sh, np.where(use_c, OOB, o_sl).astype(np.int32),
+                        c_sh, c_sl, use_c, self.pools[cid])
+                    if out is None:  # staging pool budget exhausted
+                        self.stats["pool_full"] += 1
+                        raise _StagingAbort()
+                    vals, rows = out
+                    acquired.append((self.pools[cid], rows))
+                    n_remote += nr
+                    if record and srv.locality is not None:
+                        # recorded at stage time, mirroring _pull's
+                        # per-pull record; an expired (never-consumed)
+                        # entry skews the counters by at most
+                        # prefetch_max_batches batches, and restages
+                        # pass record=False so an eventual pull is
+                        # counted exactly once
+                        srv.locality.record(ks.ravel(), local.ravel())
+                    gpos = pos if loc_map is None else loc_map[pos]
+                    groups.append((cid, gpos, srv.value_lengths[ks], vals,
+                                   len(ks)))
+            except BaseException as e:
+                # release every row already accounted — a mid-loop
+                # failure (pool budget, a flaky dispatch) must not leak
+                # budget until staging is permanently wedged
+                for pool, rows in acquired:
+                    pool.release(rows)
+                if isinstance(e, _StagingAbort):
+                    return False
+                raise
+            entry = _StagedPull(keys, fp, srv.topology_version, groups,
+                                n_remote, worker.worker_id, end, acquired)
+            # register while STILL holding the server lock: note_writes
+            # runs under it, so a write can never land between the
+            # gather above and the entry becoming visible for
+            # invalidation (the read-your-writes guarantee)
+            with self._plock:
+                old = self._staged.pop((worker.worker_id, fp), None)
+                if old is not None:
+                    self._mask_sub(old.keys)
+                    self._release(old)
+                mine = [k for k in self._staged
+                        if k[0] == worker.worker_id]
+                while len(mine) >= max(1, self.opts.prefetch_max_batches):
+                    victim = self._staged.pop(mine.pop(0))
+                    self._mask_sub(victim.keys)
+                    self._release(victim)
+                    self.stats["evicted"] += 1
+                self._staged[(worker.worker_id, fp)] = entry
+                self._mask_add(keys)
+        self.stats["staged"] += 1
+        return True
+
+    def report(self) -> Dict[str, int]:
+        out = dict(self.stats)
+        out["live"] = len(self._staged)
+        return out
 
 
 def _norm_quantile(q: float) -> float:

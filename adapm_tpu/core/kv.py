@@ -24,6 +24,7 @@ Design notes (see ARCHITECTURE.md):
 """
 from __future__ import annotations
 
+import contextlib
 import threading
 from typing import Dict, List, Optional, Sequence, Tuple, Union
 
@@ -51,6 +52,19 @@ class _WaitEntry:
         self.keys = keys
         self.remote = remote      # (positions, Future) for cross-process keys
         self.futures = futures or []  # outstanding cross-process writes
+
+
+class _TopoHandle:
+    """Yielded by Server._topology_mutation; cancel() marks a section
+    that mutated nothing (exit then skips the version bump)."""
+
+    __slots__ = ("cancelled",)
+
+    def __init__(self):
+        self.cancelled = False
+
+    def cancel(self):
+        self.cancelled = True
 
 
 class Server:
@@ -115,6 +129,12 @@ class Server:
             [s.cache_slots for s in self.stores],
             num_procs=self.num_procs, pid=self.pid)
 
+        # addressbook-mutation discipline (ADVICE r5 #1): every counted
+        # ab mutation must happen inside _topology_mutation(), which
+        # bumps topology_version as the LAST step of the critical
+        # section and acknowledges the count here
+        self._ab_mut_acked = self.ab.mutations
+
         self.num_shards = self.ctx.num_shards
         # explicit num_workers DECLARES the worker set (reference
         # Setup(num_keys, num_threads)): worker barriers then rendezvous
@@ -126,8 +146,12 @@ class Server:
         self._clocks = np.zeros(self.max_workers, dtype=np.int64)
         self._lock = threading.RLock()
         # serializes sync ROUNDS (planner) without holding _lock across DCN
-        # round-trips — see parallel/pm.py locking discipline
-        self._round_lock = threading.Lock()
+        # round-trips — see parallel/pm.py locking discipline. Reentrant:
+        # run_round acquires it itself (the prefetch pipeline drives
+        # rounds from a background thread, so bare run_round calls from
+        # tests/benches must self-serialize), and wait_sync/quiesce wrap
+        # it around multi-call sequences.
+        self._round_lock = threading.RLock()
         self._in_setup = False
         # worker-thread barrier state (reference ColoKVWorker::Barrier is a
         # barrier over ALL workers, threads included, via the scheduler's
@@ -146,6 +170,16 @@ class Server:
         self.sync = SyncManager(self, self.opts)
         self._sync_thread: Optional[threading.Thread] = None
         self._sync_stop = threading.Event()
+
+        # routing-plan cache + intent-driven prefetch pipeline (the hot
+        # Pull/Push path levers; core/intent.py). Both revalidate against
+        # topology_version, i.e. they depend on the _topology_mutation
+        # discipline above.
+        from .intent import PlanCache, PrefetchScheduler
+        self._plan_cache = PlanCache(self.opts.plan_cache_entries) \
+            if self.opts.plan_cache_entries > 0 else None
+        self.prefetch = PrefetchScheduler(self, self.opts) \
+            if self.opts.prefetch else None
 
         # debug: per-key additive-apply counter (ADAPM_DEBUG_APPLIES=1);
         # diagnostics only — see tests/mp_bisect.py
@@ -191,6 +225,51 @@ class Server:
             owners = self.ab.owner[traced]
             for s in np.unique(owners):
                 self.tracer.record(traced[owners == s], ALLOC, int(s))
+
+    # -- topology-mutation discipline ----------------------------------------
+
+    def _check_topology_discipline(self) -> None:
+        """Debug assertion pairing addressbook mutations with a
+        topology_version bump: every counted ab mutation must have gone
+        through _topology_mutation(). Cheap (one int compare), so it
+        runs on every entry to the context manager and on the optimistic
+        revalidation path."""
+        assert self.ab.mutations == self._ab_mut_acked, (
+            "addressbook mutated outside Server._topology_mutation(): "
+            "optimistic routing, the plan cache and staged prefetch "
+            "buffers revalidate against topology_version, so an "
+            "unpaired mutation lets stale plans dispatch into freed or "
+            "reassigned pool slots")
+
+    @contextlib.contextmanager
+    def _topology_mutation(self):
+        """THE addressbook-mutation discipline (ADVICE r5 #1). Every site
+        that mutates placement tables must run inside this context: it
+        holds the server lock and bumps `topology_version` as the LAST
+        mutation of its critical section on exit — the invariant that
+        makes optimistic routing's plan-then-revalidate sound (a stale
+        plan can never pass revalidation, because the bump is visible
+        before the lock is released). The yielded handle's `cancel()`
+        marks a section that turned out to mutate nothing (e.g. a
+        relocation whose whole batch demoted); exit then asserts nothing
+        WAS mutated, so a cancelled-but-mutated section fails loudly
+        instead of leaking an unbumped mutation."""
+        with self._lock:
+            self._check_topology_discipline()
+            before = self.ab.mutations
+            h = _TopoHandle()
+            try:
+                yield h
+            finally:
+                # bump even when the section raised: a PARTIAL mutation
+                # must still fail every outstanding optimistic plan
+                if h.cancelled:
+                    assert self.ab.mutations == before, (
+                        "topology mutation section cancelled after "
+                        "mutating the addressbook")
+                else:
+                    self.topology_version += 1
+                    self._ab_mut_acked = self.ab.mutations
 
     # -- worker management ---------------------------------------------------
 
@@ -361,12 +440,15 @@ class Server:
             groups.append((cid, gpos, self.value_lengths[ks], vals, len(ks)))
         return groups, n_remote, remote
 
-    def _plan_push(self, keys: np.ndarray, vals: np.ndarray, shard: int,
-                   is_set: bool = False):
-        """Routing + staging plan for `_push`: no device dispatch, no side
-        effects; same lock-free contract as `_plan_pull`."""
-        flat = vals.ndim == 1
-        rem = None
+    def _plan_push_routes(self, keys: np.ndarray, shard: int,
+                          is_set: bool = False):
+        """The cacheable routing part of `_plan_push`: everything derived
+        from the key batch and the tables alone — the PlanCache entry for
+        the 'push'/'set' kinds. Value staging is applied per call by
+        `_plan_push` (values change every step; routes only change with
+        the topology)."""
+        rem_pos = loc_pos = None
+        kloc = keys
         if self.glob is not None:
             # Set must reach the owner; Push may land in a local replica's
             # delta row (same split as the reference's local attempt)
@@ -376,32 +458,48 @@ class Server:
                 proc_rem = (self.ab.owner[keys] < 0) & \
                     (self.ab.cache_slot[shard, keys] < 0)
             if proc_rem.any():
-                from ..parallel.pm import _offsets, _select_flat
                 rem_pos = np.nonzero(proc_rem)[0]
-                rem_keys = keys[rem_pos]
-                if flat:
-                    lens = self.value_lengths[keys]
-                    rem_flat = _select_flat(vals, _offsets(lens), lens,
-                                            rem_pos)
-                else:
-                    rem_flat = np.ascontiguousarray(vals[rem_pos]).ravel()
                 loc_pos = np.nonzero(~proc_rem)[0]
-                if flat:
-                    vals = _select_flat(vals, _offsets(lens), lens, loc_pos)
-                else:
-                    vals = vals[loc_pos]
-                keys = keys[loc_pos]
-                rem = (rem_pos, rem_keys, rem_flat)
+                kloc = keys[loc_pos]
         cls = []
-        if len(keys):
-            for cid, pos in self._group_by_class(keys):
-                ks = keys[pos]
-                L = self.class_lengths[cid]
-                rows = self._flat_parts(keys, vals, pos, L) if flat \
-                    else vals[pos]
-                cls.append((cid, ks, rows,
+        if len(kloc):
+            for cid, pos in self._group_by_class(kloc):
+                ks = kloc[pos]
+                cls.append((cid, pos, ks,
                             self._route(ks, shard, write_through=is_set,
                                         record=False)))
+        return (rem_pos, loc_pos, cls)
+
+    def _plan_push(self, keys: np.ndarray, vals: np.ndarray, shard: int,
+                   is_set: bool = False, routes=None):
+        """Routing + staging plan for `_push`: no device dispatch, no side
+        effects; same lock-free contract as `_plan_pull`. `routes` is an
+        optional pre-computed (possibly plan-cached) `_plan_push_routes`
+        result for the same (keys, shard, is_set)."""
+        if routes is None:
+            routes = self._plan_push_routes(keys, shard, is_set=is_set)
+        rem_pos, loc_pos, cls_r = routes
+        flat = vals.ndim == 1
+        rem = None
+        if rem_pos is not None:
+            from ..parallel.pm import _offsets, _select_flat
+            rem_keys = keys[rem_pos]
+            if flat:
+                lens = self.value_lengths[keys]
+                offs = _offsets(lens)
+                rem_flat = _select_flat(vals, offs, lens, rem_pos)
+                vals = _select_flat(vals, offs, lens, loc_pos)
+            else:
+                rem_flat = np.ascontiguousarray(vals[rem_pos]).ravel()
+                vals = vals[loc_pos]
+            keys = keys[loc_pos]
+            rem = (rem_pos, rem_keys, rem_flat)
+        cls = []
+        for cid, pos, ks, route in cls_r:
+            L = self.class_lengths[cid]
+            rows = self._flat_parts(keys, vals, pos, L) if flat \
+                else vals[pos]
+            cls.append((cid, ks, rows, route))
         return (rem, cls)
 
     def _push(self, keys: np.ndarray, vals: np.ndarray, shard: int,
@@ -410,6 +508,7 @@ class Server:
         writes (multi-process only; `after` = the worker's earlier write
         futures, chained to preserve per-worker write order). `plan` is an
         optional `_plan_push` result revalidated under the lock."""
+        self._prefetch_note(keys)
         if plan is None:
             plan = self._plan_push(keys, vals, shard, is_set=is_set)
         rem, cls = plan
@@ -499,9 +598,32 @@ class Server:
             _fill_flat(out, offs, lens, pos, rows.ravel())
         return out
 
+    def _plan_cached(self, kind: str, shard: int, keys: np.ndarray,
+                     tv: int, compute):
+        """The one plan-cache get-or-compute-then-put sequence (shared by
+        Worker.pull/push/set and the prefetch staging path, so the
+        caching contract lives in one place)."""
+        cache = self._plan_cache
+        plan = cache.get(kind, shard, keys, tv) \
+            if cache is not None else None
+        if plan is None:
+            plan = compute()
+            if cache is not None:
+                cache.put(kind, shard, keys, tv, plan)
+        return plan
+
+    def _prefetch_note(self, keys: np.ndarray) -> None:
+        """Invalidate staged prefetch buffers that intersect a value
+        write (caller holds the lock; every write path must pass through
+        here BEFORE a reader could miss the write — see
+        PrefetchScheduler.note_writes)."""
+        if self.prefetch is not None:
+            self.prefetch.note_writes(keys)
+
     def _apply_remote_write(self, keys: np.ndarray, flat: np.ndarray,
                             is_set: bool) -> None:
         """Apply a cross-process push/set to locally-owned main rows."""
+        self._prefetch_note(keys)
         flat = np.asarray(flat, dtype=np.float32)
         for cid, pos in self._group_by_class(keys):
             ks = keys[pos]
@@ -578,13 +700,13 @@ class Server:
         keys = keys[self.ab.cache_slot[shard, keys] >= 0]
         if len(keys) == 0:
             return
-        chans = key_channel(keys, self.sync.num_channels)
-        for k, c in zip(keys.tolist(), chans.tolist()):
-            self.sync.replicas[c].discard((int(k), shard))
-        for _, pos in self._group_by_class(keys):
-            self.ab.drop_replicas(keys[pos], shard)
-        self.sync.stats.replicas_dropped += len(keys)
-        self.topology_version += 1
+        with self._topology_mutation():
+            chans = key_channel(keys, self.sync.num_channels)
+            for k, c in zip(keys.tolist(), chans.tolist()):
+                self.sync.replicas[c].discard((int(k), shard))
+            for _, pos in self._group_by_class(keys):
+                self.ab.drop_replicas(keys[pos], shard)
+            self.sync.stats.replicas_dropped += len(keys)
 
     def _flush_drop_local_replicas(self, keys: np.ndarray) -> None:
         """Flush pending deltas of all local replicas of `keys` into their
@@ -600,15 +722,15 @@ class Server:
         self._sync_replicas(items)
         karr = np.fromiter((k for k, _ in items), np.int64, len(items))
         sarr = np.fromiter((s for _, s in items), np.int32, len(items))
-        chans = key_channel(karr, self.sync.num_channels)
-        for (k, s), c in zip(items, chans.tolist()):
-            self.sync.replicas[c].discard((k, s))
-        for s in np.unique(sarr):
-            sk = karr[sarr == s]
-            for _, pos in self._group_by_class(sk):
-                self.ab.drop_replicas(sk[pos], int(s))
-        self.sync.stats.replicas_dropped += len(items)
-        self.topology_version += 1
+        with self._topology_mutation():
+            chans = key_channel(karr, self.sync.num_channels)
+            for (k, s), c in zip(items, chans.tolist()):
+                self.sync.replicas[c].discard((k, s))
+            for s in np.unique(sarr):
+                sk = karr[sarr == s]
+                for _, pos in self._group_by_class(sk):
+                    self.ab.drop_replicas(sk[pos], int(s))
+            self.sync.stats.replicas_dropped += len(items)
 
     # -- planner ops (called by SyncManager) ---------------------------------
 
@@ -628,21 +750,23 @@ class Server:
             if len(todo) == 0:
                 return np.empty(0, dtype=np.int64)
             created = []
-            for cid, pos in self._group_by_class(todo):
-                cs = ab.add_replicas(todo[pos], shard)
-                ks = todo[pos][: len(cs)]
-                if len(ks) == 0:
-                    continue
-                c_sl = cs.astype(np.int32)
-                o_sh = ab.owner[ks].astype(np.int32)
-                o_sl = ab.slot[ks].astype(np.int32)
-                c_sh = np.full_like(o_sh, shard)
-                self.stores[cid].replica_create(o_sh, o_sl, c_sh, c_sl)
-                created.append(ks)
+            with self._topology_mutation() as tm:
+                for cid, pos in self._group_by_class(todo):
+                    cs = ab.add_replicas(todo[pos], shard)
+                    ks = todo[pos][: len(cs)]
+                    if len(ks) == 0:
+                        continue
+                    c_sl = cs.astype(np.int32)
+                    o_sh = ab.owner[ks].astype(np.int32)
+                    o_sl = ab.slot[ks].astype(np.int32)
+                    c_sh = np.full_like(o_sh, shard)
+                    self.stores[cid].replica_create(o_sh, o_sl, c_sh, c_sl)
+                    created.append(ks)
+                if not created:
+                    tm.cancel()  # cache pool full: nothing materialized
             if not created:
                 return np.empty(0, dtype=np.int64)
             out = np.concatenate(created)
-            self.topology_version += 1
             if self.tracer is not None:
                 from ..utils.stats import REPLICA_SETUP
                 self.tracer.record(out, REPLICA_SETUP, shard)
@@ -657,6 +781,10 @@ class Server:
             ab = self.ab
             karr = np.array([k for k, _ in items], dtype=np.int64)
             sarr = np.array([s for _, s in items], dtype=np.int32)
+            # a sync refreshes replica bases (and may advance owner rows):
+            # staged pull buffers of these keys are no longer what a
+            # fresh pull would return
+            self._prefetch_note(karr)
             for cid, pos in self._group_by_class(karr):
                 ks, ss = karr[pos], sarr[pos]
                 r_cs = ab.cache_slot[ss, ks].astype(np.int32)
@@ -690,14 +818,14 @@ class Server:
             # free the slots (reference readAndPotentiallyDropReplica) —
             # grouped per (shard, class), not per key
             self._sync_replicas(items)
-            for s in np.unique(sarr):
-                sk = karr[sarr == s]
-                for _, pos in self._group_by_class(sk):
-                    self.ab.drop_replicas(sk[pos], int(s))
-                if self.tracer is not None:
-                    from ..utils.stats import REPLICA_DROP
-                    self.tracer.record(sk, REPLICA_DROP, int(s))
-            self.topology_version += 1
+            with self._topology_mutation():
+                for s in np.unique(sarr):
+                    sk = karr[sarr == s]
+                    for _, pos in self._group_by_class(sk):
+                        self.ab.drop_replicas(sk[pos], int(s))
+                    if self.tracer is not None:
+                        from ..utils.stats import REPLICA_DROP
+                        self.tracer.record(sk, REPLICA_DROP, int(s))
 
     def _relocate(self, moves: List[Tuple[int, int]]) -> int:
         """Move main copies given (key, dest_shard) pairs. Returns the number
@@ -731,36 +859,39 @@ class Server:
             keys = keys[(ab.owner[keys] != dest) & (ab.owner[keys] >= 0)]
             if len(keys) == 0:
                 return 0
-            for cid, pos in self._group_by_class(keys):
-                ks = keys[pos]
-                moved, old_sh, old_sl, new_sl = ab.relocate_batch(ks, dest)
-                if len(moved) < len(ks):  # pool full: demote the rest
-                    demoted = np.concatenate((demoted, ks[len(moved):]))
-                if len(moved) == 0:
-                    continue
-                # a replica at the destination upgrades to owner: its
-                # pending delta merges in-kernel (rc coords), and its
-                # cache slot is freed
-                cs = ab.cache_slot[dest, moved]
-                has_rep = cs >= 0
-                rc_sh = np.where(has_rep, dest, 0).astype(np.int32)
-                rc_sl = np.where(has_rep, cs, OOB).astype(np.int32)
-                rep_keys = moved[has_rep]
-                if len(rep_keys):
-                    chans = key_channel(rep_keys, self.sync.num_channels)
-                    for k, c in zip(rep_keys.tolist(), chans.tolist()):
-                        self.sync.replicas[c].discard((k, dest))
-                    ab.drop_replicas(rep_keys, dest)
-                self.stores[cid].relocate_rows(
-                    old_sh.astype(np.int32), old_sl.astype(np.int32),
-                    np.full(len(moved), dest, np.int32),
-                    new_sl.astype(np.int32), rc_sh, rc_sl)
-                n_moved += len(moved)
-                if self.tracer is not None:
-                    from ..utils.stats import RELOCATE
-                    self.tracer.record(moved, RELOCATE, dest)
-            if n_moved:
-                self.topology_version += 1
+            with self._topology_mutation() as tm:
+                for cid, pos in self._group_by_class(keys):
+                    ks = keys[pos]
+                    moved, old_sh, old_sl, new_sl = \
+                        ab.relocate_batch(ks, dest)
+                    if len(moved) < len(ks):  # pool full: demote the rest
+                        demoted = np.concatenate((demoted, ks[len(moved):]))
+                    if len(moved) == 0:
+                        continue
+                    # a replica at the destination upgrades to owner: its
+                    # pending delta merges in-kernel (rc coords), and its
+                    # cache slot is freed
+                    cs = ab.cache_slot[dest, moved]
+                    has_rep = cs >= 0
+                    rc_sh = np.where(has_rep, dest, 0).astype(np.int32)
+                    rc_sl = np.where(has_rep, cs, OOB).astype(np.int32)
+                    rep_keys = moved[has_rep]
+                    if len(rep_keys):
+                        chans = key_channel(rep_keys,
+                                            self.sync.num_channels)
+                        for k, c in zip(rep_keys.tolist(), chans.tolist()):
+                            self.sync.replicas[c].discard((k, dest))
+                        ab.drop_replicas(rep_keys, dest)
+                    self.stores[cid].relocate_rows(
+                        old_sh.astype(np.int32), old_sl.astype(np.int32),
+                        np.full(len(moved), dest, np.int32),
+                        new_sl.astype(np.int32), rc_sh, rc_sl)
+                    n_moved += len(moved)
+                    if self.tracer is not None:
+                        from ..utils.stats import RELOCATE
+                        self.tracer.record(moved, RELOCATE, dest)
+                if n_moved == 0:
+                    tm.cancel()  # whole batch demoted: nothing moved
         if len(demoted):
             created = self._create_replicas(demoted, dest)
             chans = key_channel(created, self.sync.num_channels)
@@ -921,7 +1052,22 @@ class Server:
         from ..parallel import control
         return control.dead_processes(max_age_s)
 
+    def drive_rounds(self, n: int = 1) -> None:
+        """One training step's planner-drive slot (the apps' per-step
+        `sync.run_round` loop): inline when no prefetch pipeline, else
+        delegated to the pipeline's background thread so planner work —
+        relocations, replica churn, and the device-table re-uploads they
+        trigger — overlaps the in-flight device step instead of
+        serializing after it."""
+        if self.prefetch is not None:
+            self.prefetch.pump(n)
+        else:
+            for _ in range(n):
+                self.sync.run_round()
+
     def shutdown(self) -> None:
+        if self.prefetch is not None:
+            self.prefetch.close()
         self.stop_sync_thread()
         self.block()
         self.sync.close()
@@ -970,6 +1116,12 @@ class Server:
                 alog("[stats] " + " ".join(f"{k}={v:.3f}" for k, v in
                                            summ.items() if v == v))
             alog("[stats]", self.sync.report())
+            if self.prefetch is not None:
+                alog("[stats] prefetch: " + " ".join(
+                    f"{k}={v}" for k, v in self.prefetch.report().items()))
+            if self._plan_cache is not None:
+                alog("[stats] plan_cache: " + " ".join(
+                    f"{k}={v}" for k, v in self._plan_cache.stats().items()))
         if not self.opts.stats_out:
             return []
         from ..parallel import control
@@ -1102,20 +1254,50 @@ class Worker:
         self._write_futs = [f for f in self._write_futs if not f.done()]
         return list(self._write_futs)
 
+    def _cached_push_routes(self, keys: np.ndarray, tv: int, is_set: bool):
+        """Route skeleton for push/set through the plan cache (values are
+        applied per call; routes only change with the topology)."""
+        srv = self.server
+        return srv._plan_cached(
+            "set" if is_set else "push", self.shard, keys, tv,
+            lambda: srv._plan_push_routes(keys, self.shard, is_set=is_set))
+
     def pull(self, keys, out: Optional[np.ndarray] = None) -> int:
         """Async pull. Returns ts (use wait) or LOCAL=-1 if every key was
         served from this worker's shard (owned or replicated) — in that case
-        `out` is already filled when provided."""
+        `out` is already filled when provided.
+
+        Fast path: a batch this worker declared intent for may have been
+        pre-gathered by the prefetch pipeline (core/intent.py); the pull
+        then consumes the staged device buffers directly — no planning,
+        no server lock, no dispatch. Validity (topology unchanged since
+        the gather, no intersecting write) was enforced by the pipeline,
+        so a staged hit is bit-identical to the pull it replaced."""
         keys = self._keys(keys)
         srv = self.server
+        if srv.prefetch is not None:
+            st = srv.prefetch.take_staged(self, keys)
+            if st is not None:
+                self.stats["pull_ops"] += 1
+                self.stats["pull_params"] += len(keys)
+                self.stats["pull_params_local"] += len(keys) - st.n_remote
+                entry = _WaitEntry(groups=st.groups, out=out, keys=keys)
+                if st.n_remote == 0:
+                    self.stats["pull_ops_local"] += 1
+                    self._finish_pull(keys, entry)
+                    return LOCAL
+                return self._new_ts(entry)
         after = self._live_write_futs() if srv.glob is not None else ()
         plan, tv = None, -1
         if srv.opts.optimistic_routing:
             # route + stage outside the lock; revalidate the topology
             # below (reference: per-key lock array lets N worker threads
-            # route concurrently, handle.h:1069-1083)
+            # route concurrently, handle.h:1069-1083). Identical batches
+            # skip planning entirely via the plan cache.
             tv = srv.topology_version
-            plan = srv._plan_pull(keys, self.shard)
+            plan = srv._plan_cached(
+                "pull", self.shard, keys, tv,
+                lambda: srv._plan_pull(keys, self.shard))
         with srv._lock:
             if plan is not None and srv.topology_version != tv:
                 plan = None  # topology moved underneath us: re-plan
@@ -1175,7 +1357,9 @@ class Worker:
         plan, tv = None, -1
         if srv.opts.optimistic_routing:
             tv = srv.topology_version
-            plan = srv._plan_push(keys, vals, self.shard, is_set=False)
+            plan = srv._plan_push(
+                keys, vals, self.shard, is_set=False,
+                routes=self._cached_push_routes(keys, tv, is_set=False))
         with srv._lock:
             if plan is not None and srv.topology_version != tv:
                 plan = None
@@ -1223,7 +1407,9 @@ class Worker:
         plan, tv = None, -1
         if srv.opts.optimistic_routing:
             tv = srv.topology_version
-            plan = srv._plan_push(keys, vals, self.shard, is_set=True)
+            plan = srv._plan_push(
+                keys, vals, self.shard, is_set=True,
+                routes=self._cached_push_routes(keys, tv, is_set=True))
         with dm:
             with srv._lock:
                 if plan is not None and srv.topology_version != tv:
@@ -1282,10 +1468,16 @@ class Worker:
     def intent(self, keys, start: int, end: Optional[int] = None) -> None:
         """Declare future access to `keys` in clock window [start, end]
         (reference Intent, coloc_kv_worker.h:380-408; end defaults to
-        start)."""
+        start). With the prefetch pipeline on, the declaration also
+        queues background staging: a later `pull` of exactly this
+        (unique, sorted) key batch inside the window can be served from
+        a pre-gathered staged buffer."""
         keys = np.unique(self._keys(keys))
         end = start if end is None else end
         self._intent_queue.push(keys, int(start), int(end))
+        srv = self.server
+        if srv.prefetch is not None:
+            srv.prefetch.on_intent(self, keys, int(start), int(end))
 
     def advance_clock(self) -> int:
         self._clock += 1

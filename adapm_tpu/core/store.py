@@ -190,6 +190,42 @@ def _relocate(main, delta, old_shard, old_slot, new_shard, new_slot,
 # ---------------------------------------------------------------------------
 
 
+class StagingPool:
+    """Row budget for device-resident staged gather buffers (one per
+    length class; core/intent.py PrefetchScheduler).
+
+    Not a preallocated arena: XLA's gather already materializes its
+    output in a fresh device buffer, so copying that into a reserved
+    pool would only add a device-to-device copy. What staging needs is a
+    BOUND — prefetch must not be able to OOM HBM by racing ahead of the
+    consumer — so the pool accounts rows (buffers stay owned by the
+    staged entries) and `stage_gather` refuses to gather past the
+    budget. Thread-safe: the prefetch thread acquires, any thread that
+    drops/consumes an entry releases."""
+
+    def __init__(self, max_rows: int):
+        import threading
+        self.max_rows = max_rows
+        self._rows = 0
+        self._lock = threading.Lock()
+
+    def try_acquire(self, rows: int) -> bool:
+        with self._lock:
+            if self._rows + rows > self.max_rows:
+                return False
+            self._rows += rows
+            return True
+
+    def release(self, rows: int) -> None:
+        with self._lock:
+            self._rows -= rows
+            assert self._rows >= 0, "staging pool released more than held"
+
+    @property
+    def rows_in_use(self) -> int:
+        return self._rows
+
+
 class ShardedStore:
     """Pools for one length class. Index-level API; key routing lives above."""
 
@@ -248,6 +284,22 @@ class ShardedStore:
                        (c_slot, OOB), (use_cache, False),
                        minimum=self.bucket_min)
         return _gather(self.main, self.cache, self.delta, *a)
+
+    def stage_gather(self, o_shard, o_slot, c_shard, c_slot, use_cache,
+                     pool: "StagingPool"):
+        """The gather-into-staging program (prefetch pipeline): identical
+        program and result to `gather` — a staged pull must be
+        bit-identical to the pull it replaces — but accounted against
+        `pool`'s row budget. Returns (device rows, accounted row count),
+        or None when the budget is exhausted (the caller skips staging;
+        the consumer falls back to a plain pull — slower, never wrong).
+        The caller must `pool.release(rows)` when the staged buffer is
+        consumed or dropped."""
+        rows = bucket_size(len(o_shard), self.bucket_min)
+        if not pool.try_acquire(rows):
+            return None
+        return self.gather(o_shard, o_slot, c_shard, c_slot,
+                           use_cache), rows
 
     def scatter_add(self, o_shard, o_slot, d_shard, d_slot, vals):
         n = len(o_shard)

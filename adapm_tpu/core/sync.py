@@ -264,26 +264,35 @@ class SyncManager:
 
     def run_round(self, force_intents: bool = False,
                   all_channels: bool = False) -> None:
-        self._throttle()
-        if self.server._in_setup and not force_intents:
-            # BeginSetup/EndSetup bracket (reference coloc_kv_worker.h):
-            # management is paused so bulk Set/Push of initial values runs
-            # at full speed; EndSetup's barrier resumes it. An explicit
-            # WaitSync (force) still acts.
-            return
-        self.drain_intents(force=force_intents)
-        if all_channels:
-            self._sync_all_channels()
-        else:
-            self.sync_channel(self._next_channel)
-            self._next_channel = (self._next_channel + 1) % self.num_channels
-        if force_intents and all_channels:
-            # the WaitSync shape: in collective mode this is the agreed
-            # point where every process joins the BSP delta exchange
-            self._collective_point()
-        else:
-            self._maybe_cadence()
-        self.stats.rounds += 1
+        # self-serializing (the round lock is reentrant): rounds may now
+        # be driven concurrently by the training thread, the background
+        # sync thread, AND the prefetch pipeline — drain_intents pops
+        # worker heaps and sync_channel walks replica sets, neither of
+        # which tolerates interleaved rounds
+        with self.server._round_lock:
+            self._throttle()
+            if self.server._in_setup and not force_intents:
+                # BeginSetup/EndSetup bracket (reference
+                # coloc_kv_worker.h): management is paused so bulk
+                # Set/Push of initial values runs at full speed;
+                # EndSetup's barrier resumes it. An explicit WaitSync
+                # (force) still acts.
+                return
+            self.drain_intents(force=force_intents)
+            if all_channels:
+                self._sync_all_channels()
+            else:
+                self.sync_channel(self._next_channel)
+                self._next_channel = \
+                    (self._next_channel + 1) % self.num_channels
+            if force_intents and all_channels:
+                # the WaitSync shape: in collective mode this is the
+                # agreed point where every process joins the BSP delta
+                # exchange
+                self._collective_point()
+            else:
+                self._maybe_cadence()
+            self.stats.rounds += 1
 
     def _sync_all_channels(self) -> None:
         """All channels' rounds. Multi-process, >1 channel: issued
@@ -422,6 +431,13 @@ class SyncManager:
         this — and in multi-process, after every process quiesces and a
         barrier (WaitSync -> Barrier -> WaitSync) — all reads observe
         identical values (reference test_many_key_operations.cc:375-385)."""
+        srv = self.server
+        # same self-serialization as run_round (reentrant under the
+        # Server.quiesce wrapper)
+        with srv._round_lock:
+            self._quiesce_locked()
+
+    def _quiesce_locked(self) -> None:
         srv = self.server
         self.drain_intents(force=True)
         for c in range(self.num_channels):

@@ -43,7 +43,7 @@ eliminating the per-step sample key transfer too.
 from __future__ import annotations
 
 from functools import partial
-from typing import Callable, Dict, List, Sequence, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -258,7 +258,11 @@ def make_device_routed_step(loss_fn: Callable[..., jnp.ndarray],
     body = _build_device_routed_body(
         loss_fn, role_class, role_dim, shard, frozen_roles, neg_role,
         neg_shape, no_replicas, neg_alias)
-    return jax.jit(body, donate_argnums=(0, 1))
+    # donate the pools only: donating the 4-scalar locstat accumulator
+    # saves nothing and its aliased buffer has been observed returning
+    # stale/garbage counts on the multi-device CPU backend (flaky
+    # locality_counts mismatches in test_device_routed)
+    return jax.jit(body, donate_argnums=(0,))
 
 
 def make_device_routed_scan(loss_fn: Callable[..., jnp.ndarray],
@@ -285,7 +289,8 @@ def make_device_routed_scan(loss_fn: Callable[..., jnp.ndarray],
         loss_fn, role_class, role_dim, shard, frozen_roles, neg_role,
         neg_shape, no_replicas, neg_alias)
 
-    @partial(jax.jit, donate_argnums=(0, 1))
+    # pools-only donation, same rationale as make_device_routed_step
+    @partial(jax.jit, donate_argnums=(0,))
     def scan(pools, locstat, tables, keys, local_index, alias, rng_keys,
              aux, lr, eps):
         def f(carry, xs):
@@ -396,10 +401,34 @@ def _build_device_routed_body(loss_fn, role_class, role_dim, shard,
     return step
 
 
+class StagedKeys:
+    """A step's key batch pre-staged on device (DeviceRoutedRunner
+    .prefetch_keys): the host->device upload happened at prepare/intent
+    time instead of inside the dispatch critical section. Valid across
+    topology changes — these are raw keys, not routes."""
+
+    __slots__ = ("host", "dev")
+
+    def __init__(self, host: Dict[str, np.ndarray], dev: Dict[str, object]):
+        self.host = host
+        self.dev = dev
+
+    def matches(self, role_keys: Dict[str, np.ndarray]) -> bool:
+        if set(self.host) != set(role_keys):
+            return False
+        return all(np.array_equal(self.host[r],
+                                  np.asarray(k, dtype=self.host[r].dtype))
+                   for r, k in role_keys.items())
+
+
 class DeviceRoutedRunner:
     """FusedStepRunner's fast sibling: routing (and optionally negative
     sampling) happens on device. Per step the host ships only the raw key
     batch; table mirrors refresh lazily when the planner moves parameters.
+    With the prefetch pipeline on (SystemOptions.prefetch), the mirrors
+    are instead re-staged by the pipeline's background thread right after
+    planner rounds, and `prefetch_keys` lets the app upload a future
+    step's key batch ahead of its dispatch.
 
     Locality is recorded by a 4-scalar device accumulator folded into the
     step program (params seen / params local / steps / all-local steps) and
@@ -476,6 +505,50 @@ class DeviceRoutedRunner:
         self._rep_version = -1
         self._has_replicas = True
         self.steps = 0
+        if getattr(server, "prefetch", None) is not None:
+            server.prefetch.register_refresher(self._prefetch_refresh)
+
+    def _prefetch_refresh(self) -> None:
+        """Called by the prefetch pipeline (under the server lock) after
+        planner rounds: re-stage the device table mirrors, the local
+        sampling index, and the replica-presence flag as soon as the
+        topology settles, so the next dispatch finds them fresh instead
+        of rebuilding + re-uploading them inside its critical section."""
+        self.router.refresh()
+        if self.neg_role is not None:
+            self._local_neg_index()
+        self._shard_has_replicas()
+
+    def _note_step_writes(self, role_keys) -> None:
+        """The fused step is a batched Push in PM terms: staged pull
+        buffers covering trained keys must be invalidated like any other
+        write (caller holds the server lock). Device-drawn negatives are
+        not enumerable on the host, so runners with an in-program
+        sampler conservatively invalidate every staged batch — free in
+        practice: fused-loop workers do not Pull, so under the default
+        'auto' gating nothing is staged for them."""
+        pre = self.server.prefetch
+        if pre is None or not pre._staged:
+            return
+        if self.neg_role is not None:
+            pre.invalidate_all()
+            return
+        self.server._prefetch_note(np.concatenate(
+            [np.asarray(k, dtype=np.int64).ravel()
+             for k in role_keys.values()]))
+
+    def prefetch_keys(self, role_keys: Dict[str, np.ndarray]) -> StagedKeys:
+        """Pre-stage a future step's key batch on device (the staging
+        rule, docs/PERF.md): the upload runs now — on the app's
+        intent/prepare path — instead of inside the next dispatch.
+        Returns the handle for __call__'s `staged` parameter."""
+        srv = self.server
+        self._check_batch(role_keys)
+        kdtype = _key_dtype(srv.num_keys)
+        put = srv.ctx.put_replicated
+        host = {r: np.asarray(k, dtype=kdtype)
+                for r, k in role_keys.items()}
+        return StagedKeys(host, {r: put(v) for r, v in host.items()})
 
     def _next_rng(self):
         if not self._rng_pool:
@@ -583,10 +656,16 @@ class DeviceRoutedRunner:
             srv.ensure_local(k64, self.shard)
 
     def __call__(self, role_keys: Dict[str, np.ndarray], aux, lr: float,
-                 eps: float = 1e-10) -> jnp.ndarray:
+                 eps: float = 1e-10,
+                 staged: Optional[StagedKeys] = None) -> jnp.ndarray:
         srv = self.server
         self._check_batch(role_keys)
+        if staged is not None and not staged.matches(role_keys):
+            raise ValueError(
+                "staged keys differ from the step's batch — pass the "
+                "handle prefetch_keys returned for THIS batch")
         with srv._lock:
+            self._note_step_writes(role_keys)
             tables = self.router.tables()
             local_index = self._local_neg_index() \
                 if self.neg_role is not None else None
@@ -594,8 +673,9 @@ class DeviceRoutedRunner:
             # keys validated above to be inside [0, num_keys)
             kdtype = _key_dtype(srv.num_keys)
             put = srv.ctx.put_replicated  # the staging rule, mesh.py
-            keys = {r: put(np.asarray(k, dtype=kdtype))
-                    for r, k in role_keys.items()}
+            keys = staged.dev if staged is not None else \
+                {r: put(np.asarray(k, dtype=kdtype))
+                 for r, k in role_keys.items()}
             pools = tuple((s.main, s.cache, s.delta) for s in srv.stores)
             fn = self.step_fn if self._shard_has_replicas() \
                 else self._step_fn_norep
@@ -637,6 +717,8 @@ class DeviceRoutedRunner:
         if has_aux:
             assert len(auxes) == K, "one aux per batch"
         with srv._lock:
+            for b in batches:
+                self._note_step_writes(b)
             tables = self.router.tables()
             local_index = self._local_neg_index() \
                 if self.neg_role is not None else None
@@ -701,6 +783,13 @@ class FusedStepRunner:
                  eps: float = 1e-10, shard: int = 0) -> jnp.ndarray:
         srv = self.server
         with srv._lock:
+            # a fused step is a batched Push: invalidate staged pull
+            # buffers of the trained keys (all roles are host-provided
+            # here, so the written key set is exact)
+            if srv.prefetch is not None and srv.prefetch._staged:
+                srv._prefetch_note(np.concatenate(
+                    [np.asarray(k, dtype=np.int64).ravel()
+                     for k in role_keys.values()]))
             routes = self.routes_for(role_keys, shard)
             pools = tuple((s.main, s.cache, s.delta) for s in srv.stores)
             pools, loss = self.step_fn(

@@ -105,10 +105,15 @@ class CollectiveSync:
     def _fn(self, nleaves: int):
         import jax
         from jax.sharding import PartitionSpec
+        # jax.shard_map graduated from jax.experimental.shard_map; this
+        # image's jax predates the top-level alias
+        shard_map = getattr(jax, "shard_map", None)
+        if shard_map is None:
+            from jax.experimental.shard_map import shard_map
         fn = self._fns.get(nleaves)
         if fn is None:
             @jax.jit
-            @partial(jax.shard_map, mesh=self._mesh,
+            @partial(shard_map, mesh=self._mesh,
                      in_specs=PartitionSpec("p"),
                      out_specs=PartitionSpec("p"))
             def xchg(tree):
@@ -194,7 +199,12 @@ class CollectiveSync:
             cls_pos.append(pos)
             my_counts[cid] = len(pos)
         my_counts[ncls] = 1.0 if quiescing else 0.0
-        global_counts = control.allreduce(my_counts, "sum")
+        # own collective site: the exchange may be driven from a sync/
+        # prefetch thread while the app thread runs its own "ar"-site
+        # allreduces (RuntimeGuard, loss merges) — distinct sites pair
+        # independently per rank (control.allreduce contract)
+        global_counts = control.allreduce(my_counts, "sum",
+                                          site="coll-counts")
         all_quiescing = bool(global_counts[ncls] >= self._P)
         for cid, L in enumerate(pm.server.class_lengths):
             if global_counts[cid] == 0:
@@ -318,7 +328,8 @@ class CollectiveSync:
                 import time
                 time.sleep(0.002)  # give in-flight adoptions time to land
             # globally-agreed termination: every process sees the same sum
-            backlog = float(control.allreduce(float(len(pend)), "sum")[0])
+            backlog = float(control.allreduce(float(len(pend)), "sum",
+                                              site="coll-backlog")[0])
             if backlog == 0.0:
                 return
             if it > MAX_ROUNDS:

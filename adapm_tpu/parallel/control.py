@@ -77,7 +77,6 @@ def process_id() -> int:
     return jax.process_index()
 
 
-_barrier_seq = 0
 _barrier_lock = __import__("threading").Lock()
 
 
@@ -85,19 +84,23 @@ def barrier(name: str = "adapm") -> None:
     """Global process barrier (reference Postoffice::Barrier via the
     scheduler, src/postoffice.cc:149-174). Rides the coordinator's gRPC
     barrier — no device collectives, so it is safe to call from planner /
-    background threads while device programs are in flight. Callers must
-    barrier in the same ORDER on every process (the reference's scheduler
-    counts BARRIER messages under the same contract)."""
+    background threads while device programs are in flight.
+
+    Ordering contract: barriers of the SAME `name` must be invoked in
+    the same order on every process (sequence ids are per name, so
+    differently-named barriers interleaved differently across ranks
+    still pair correctly — the calling-site tag IS part of the id;
+    ADVICE r5 #4). Same-name barriers from two local threads racing each
+    other remain undefined — one caller thread per name."""
     import jax
     if jax.process_count() == 1:
         return
-    global _barrier_seq
     from jax._src import distributed
     client = distributed.global_state.client
     if client is not None:
         # id allocation is atomic; the wait happens outside the lock so
         # concurrent barriers from different threads both make progress
-        seq = _next_seq("barrier")
+        seq = _next_seq(f"barrier/{name}")
         # generous timeout: a peer may be inside a cold XLA compile
         client.wait_at_barrier(f"adapm/{name}/{seq}", 600_000)
         return
@@ -170,20 +173,82 @@ def dead_processes(max_age_s: float = 10.0) -> list:
     return dead
 
 
-_kv_seq = 0
+_seqs: dict = {}
+_inflight: set = set()
 
 
 def _next_seq(counter: str) -> int:
-    """Allocate the next per-primitive sequence number (shared allocator
-    for barrier and KV gather/broadcast ids; both contracts already
-    require identical call order on every process)."""
-    global _kv_seq, _barrier_seq
+    """Allocate the next sequence number for `counter`. PER-NAME
+    counters (ADVICE r5 #4): the calling-site tag is part of every KV
+    key and barrier id, so two DIFFERENT sites invoked in different
+    orders on different ranks still pair correctly instead of
+    cross-wiring each other's keys into a 600 s timeout. (The pre-r6
+    shared allocator made ANY cross-rank reordering — even of unrelated
+    primitives — a silent deadlock.)"""
     with _barrier_lock:
-        if counter == "barrier":
-            _barrier_seq += 1
-            return _barrier_seq
-        _kv_seq += 1
-        return _kv_seq
+        _seqs[counter] = _seqs.get(counter, 0) + 1
+        return _seqs[counter]
+
+
+class _exclusive:
+    """Immediate-error guard for the single-caller-thread contract: two
+    local threads driving the same collective site concurrently (e.g. a
+    sync-report thread racing an eval's allreduce) would interleave
+    sequence allocation differently across ranks — an undebuggable
+    cross-wire that used to surface as a 600 s timeout. Raise at the
+    second local entry instead (ADVICE r5 #4)."""
+
+    def __init__(self, site: str):
+        self.site = site
+
+    def __enter__(self):
+        with _barrier_lock:
+            if self.site in _inflight:
+                raise RuntimeError(
+                    f"concurrent collective call on site {self.site!r}: "
+                    "allreduce/broadcast/_kv_gather are single-caller-"
+                    "thread per site — give each calling site its own "
+                    "`site` tag, or serialize the callers")
+            _inflight.add(self.site)
+        return self
+
+    def __exit__(self, *exc):
+        with _barrier_lock:
+            _inflight.discard(self.site)
+
+
+def _pack_array(arr: np.ndarray) -> bytes:
+    """Frame an array payload with its dtype/shape so the receiver can
+    verify instead of reinterpreting bytes (ADVICE r5 #2: a root/
+    non-root template mismatch with coincidentally equal nbytes — e.g.
+    int64 vs float64 — used to silently decode garbage). ':' separators
+    on purpose: dtype.str itself BEGINS with '|' for byte-order-free
+    dtypes (bool, uint8, bytes), so '|' cannot delimit it."""
+    head = f"{arr.dtype.str}:{','.join(map(str, arr.shape))}:"
+    return head.encode() + arr.tobytes()
+
+
+def _unpack_array(raw: bytes, expect: np.ndarray,
+                  what: str) -> np.ndarray:
+    """Decode a _pack_array payload, failing loudly on any dtype/shape/
+    size mismatch against the receiver's template."""
+    sep1 = raw.index(b":")
+    sep2 = raw.index(b":", sep1 + 1)
+    dt = np.dtype(raw[:sep1].decode())
+    shape_s = raw[sep1 + 1:sep2].decode()
+    shape = tuple(int(x) for x in shape_s.split(",")) if shape_s else ()
+    if dt != expect.dtype or shape != expect.shape:
+        raise ValueError(
+            f"{what}: payload is {dt}{list(shape)} but this rank's "
+            f"template is {expect.dtype}{list(expect.shape)} — ranks "
+            "disagree on the collective's array layout")
+    body = raw[sep2 + 1:]
+    if len(body) != expect.nbytes:
+        raise ValueError(
+            f"{what}: payload carries {len(body)} bytes for a "
+            f"{expect.nbytes}-byte template")
+    # .copy(): frombuffer over bytes is read-only; callers may mutate
+    return np.frombuffer(body, dtype=dt).reshape(shape).copy()
 
 
 def _kv_gather(tag: str, payload: bytes, timeout_ms: int = 600_000):
@@ -198,28 +263,34 @@ def _kv_gather(tag: str, payload: bytes, timeout_ms: int = 600_000):
     (reference: ps_allreduce goes through the PS/scheduler, never the
     data path — include/utils.h:163-197).
 
-    Callers must invoke in the same ORDER on every process (same
-    contract as barrier()). Keys are deleted after a trailing barrier so
-    the store does not grow with call count. Requires the coordination
-    client (callers fall back to multihost_utils without one — e.g.
-    multi-host TPU auto-topology launched outside the ADAPM env)."""
+    Contract (ADVICE r5 #4): ONE caller thread per `tag`, invoking in
+    the same order on every process. Sequence ids are per tag, so
+    different tags may interleave freely across ranks; a second local
+    thread entering the same tag concurrently raises immediately
+    (_exclusive) instead of cross-wiring KV keys into a 600 s timeout.
+    Keys are deleted after a trailing barrier so the store does not grow
+    with call count. Requires the coordination client (callers fall back
+    to multihost_utils without one — e.g. multi-host TPU auto-topology
+    launched outside the ADAPM env)."""
     import base64
     import jax
     from jax._src import distributed
     client = distributed.global_state.client
-    seq = _next_seq("kv")
-    pid = jax.process_index()
-    key = f"adapm/{tag}/{seq}"
-    client.key_value_set(f"{key}/{pid}", base64.b64encode(payload).decode())
-    parts = []
-    for p in range(jax.process_count()):
-        s = client.blocking_key_value_get(f"{key}/{p}", timeout_ms)
-        parts.append(base64.b64decode(s))
-    # all ranks have read everything once all have passed this barrier;
-    # deleting one's own key is then race-free
-    barrier(f"{tag}-gc")
-    client.key_value_delete(f"{key}/{pid}")
-    return parts
+    with _exclusive(f"kv/{tag}"):
+        seq = _next_seq(f"kv/{tag}")
+        pid = jax.process_index()
+        key = f"adapm/{tag}/{seq}"
+        client.key_value_set(f"{key}/{pid}",
+                             base64.b64encode(payload).decode())
+        parts = []
+        for p in range(jax.process_count()):
+            s = client.blocking_key_value_get(f"{key}/{p}", timeout_ms)
+            parts.append(base64.b64decode(s))
+        # all ranks have read everything once all have passed this
+        # barrier; deleting one's own key is then race-free
+        barrier(f"{tag}-gc")
+        client.key_value_delete(f"{key}/{pid}")
+        return parts
 
 
 def _kv_client():
@@ -227,12 +298,19 @@ def _kv_client():
     return distributed.global_state.client
 
 
-def allreduce(values, op: str = "sum") -> np.ndarray:
+def allreduce(values, op: str = "sum", site: str = "ar") -> np.ndarray:
     """Sum/mean/max a host scalar or vector across processes (reference
     ps_allreduce, include/utils.h:163-197: push to a shared PS key, barrier,
     pull). Single-process: returns the input unchanged (as float64 array).
     Rides the coordinator KV store — never a device collective (see
-    _kv_gather for why that would deadlock)."""
+    _kv_gather for why that would deadlock).
+
+    Contract: ONE caller thread per `site`, same per-site call order on
+    every process (see _kv_gather). Callers that may run concurrently
+    with other allreduces (e.g. a guard thread vs an eval merge) must
+    pass their own `site` tag. Payloads are dtype/shape-framed, so ranks
+    disagreeing on the array layout fail loudly instead of silently
+    reinterpreting bytes (ADVICE r5 #2)."""
     import jax
     if op not in ("sum", "mean", "max"):
         raise ValueError(f"unknown allreduce op {op}")
@@ -243,17 +321,22 @@ def allreduce(values, op: str = "sum") -> np.ndarray:
         from jax.experimental import multihost_utils
         gathered = np.asarray(multihost_utils.process_allgather(arr))
     else:
-        parts = _kv_gather("ar", arr.tobytes())
-        gathered = np.stack([np.frombuffer(b, dtype=np.float64).reshape(
-            arr.shape) for b in parts])
+        parts = _kv_gather(site, _pack_array(arr))
+        gathered = np.stack([
+            _unpack_array(b, arr, f"allreduce[{site}] rank {p}")
+            for p, b in enumerate(parts)])
     return {"sum": gathered.sum, "mean": gathered.mean,
             "max": gathered.max}[op](axis=0)
 
 
-def broadcast(values, root: int = 0) -> np.ndarray:
+def broadcast(values, root: int = 0, site: str = "bc") -> np.ndarray:
     """Broadcast a host array from `root` to all processes (worker-0
-    initialization across hosts). KV-store transport, same rationale as
-    allreduce; one root-published key, O(P) coordinator messages."""
+    initialization across hosts). KV-store transport, same rationale and
+    single-caller-thread-per-site contract as allreduce; one
+    root-published key, O(P) coordinator messages. The payload carries
+    the root's dtype/shape, so a root/non-root template mismatch — even
+    with coincidentally equal nbytes (int64 vs float64) — raises instead
+    of silently reinterpreting bytes (ADVICE r5 #2)."""
     import base64
     import jax
     arr = np.asarray(values)
@@ -264,16 +347,17 @@ def broadcast(values, root: int = 0) -> np.ndarray:
         from jax.experimental import multihost_utils
         return np.asarray(multihost_utils.broadcast_one_to_all(
             arr, is_source=jax.process_index() == root)).copy()
-    seq = _next_seq("kv")
-    key = f"adapm/bc/{seq}"
-    if jax.process_index() == root:
-        client.key_value_set(key, base64.b64encode(arr.tobytes()).decode())
-    raw = base64.b64decode(client.blocking_key_value_get(key, 600_000))
-    barrier("bc-gc")
-    if jax.process_index() == root:
-        client.key_value_delete(key)
-    # .copy(): frombuffer over bytes is read-only; callers may mutate
-    return np.frombuffer(raw, dtype=arr.dtype).reshape(arr.shape).copy()
+    with _exclusive(f"kv/{site}"):
+        seq = _next_seq(f"kv/{site}")
+        key = f"adapm/{site}/{seq}"
+        if jax.process_index() == root:
+            client.key_value_set(
+                key, base64.b64encode(_pack_array(arr)).decode())
+        raw = base64.b64decode(client.blocking_key_value_get(key, 600_000))
+        barrier(f"{site}-gc")
+        if jax.process_index() == root:
+            client.key_value_delete(key)
+    return _unpack_array(raw, arr, f"broadcast[{site}]")
 
 
 # NOTE: an earlier draft exposed intent_summary_allgather here for a

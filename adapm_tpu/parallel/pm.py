@@ -553,13 +553,13 @@ class GlobalPM:
                 actions[pos] = rel_mask.astype(np.uint8)
                 owners[pos] = np.where(rel_mask, req, self.pid)
                 if len(rel_keys):
-                    self.reloc[rel_keys] = ctr[rel_mask]
-                    for cid, cpos in srv._group_by_class(rel_keys):
-                        ab.abandon_batch(rel_keys[cpos])
-                    self.owner_hint[rel_keys] = req
-                    self.interest[rel_keys] = 0
-                    self.stats["relocations_out"] += len(rel_keys)
-                    srv.topology_version += 1
+                    with srv._topology_mutation():
+                        self.reloc[rel_keys] = ctr[rel_mask]
+                        for cid, cpos in srv._group_by_class(rel_keys):
+                            ab.abandon_batch(rel_keys[cpos])
+                        self.owner_hint[rel_keys] = req
+                        self.interest[rel_keys] = 0
+                        self.stats["relocations_out"] += len(rel_keys)
                 rep_keys = ko[~rel_mask]
                 if len(rep_keys):
                     self.interest[rep_keys] |= bit
@@ -647,7 +647,7 @@ class GlobalPM:
         from ..core.sync import key_channel
         lens = srv.value_lengths[keys]
         offs = _offsets(lens)
-        with srv._lock:
+        with srv._lock, srv._topology_mutation():
             self.reloc[keys] = counters
             self.owner_hint[keys] = self.pid
             ab = srv.ab
@@ -675,7 +675,6 @@ class GlobalPM:
                 srv.stores[cid].set_rows(
                     shards.astype(np.int32), slots.astype(np.int32),
                     rows, np.zeros(nk, np.int32), np.full(nk, OOB, np.int32))
-            srv.topology_version += 1
             self.stats["relocations_in"] += len(keys)
             srv.sync.stats.relocations += len(keys)
             if srv.tracer is not None:
@@ -698,42 +697,48 @@ class GlobalPM:
             # would let a local read miss the worker's own push. Defer —
             # the key stays remote and a later intent drain retries.
             blocked = srv._rw_blocked_keys()
-            for cid, pos in srv._group_by_class(keys):
-                ks = keys[pos]
-                # an earlier entry in the same drain may have replicated (or
-                # adopted) some of these already
-                fresh = (ab.cache_slot[shard, ks] < 0) & (ab.owner[ks] < 0)
-                if blocked is not None:
-                    bl = np.isin(ks, blocked)
-                    # only keys that WOULD have been installed are deferred
-                    # + unsubscribed; keys already replicated/adopted keep
-                    # their registration (unsub would orphan them)
-                    skipped = ks[fresh & bl]
-                    if len(skipped):
-                        surplus.append(skipped)
-                    fresh &= ~bl
-                ks, pos = ks[fresh], pos[fresh]
-                if len(ks) == 0:
-                    continue
-                L = srv.class_lengths[cid]
-                cs = ab.add_replicas(ks, shard)
-                took = ks[: len(cs)]
-                if len(took):
-                    rows = _select_flat(flat, offs, lens,
-                                        pos[: len(cs)]).reshape(-1, L)
-                    srv.stores[cid].install_replica_rows(
-                        np.full(len(took), shard, np.int32),
-                        cs.astype(np.int32), rows)
-                    chans = key_channel(took, srv.sync.num_channels)
-                    for k, c in zip(took.tolist(), chans.tolist()):
-                        srv.sync.replicas[c].add((int(k), shard))
-                    srv.sync.stats.replicas_created += len(took)
-                    if srv.tracer is not None:
-                        from ..utils.stats import REPLICA_SETUP
-                        srv.tracer.record(took, REPLICA_SETUP, shard)
-                if len(cs) < len(ks):  # cache pool full
-                    surplus.append(ks[len(cs):])
-            srv.topology_version += 1
+            with srv._topology_mutation() as tm:
+                installed = 0
+                for cid, pos in srv._group_by_class(keys):
+                    ks = keys[pos]
+                    # an earlier entry in the same drain may have
+                    # replicated (or adopted) some of these already
+                    fresh = (ab.cache_slot[shard, ks] < 0) & \
+                        (ab.owner[ks] < 0)
+                    if blocked is not None:
+                        bl = np.isin(ks, blocked)
+                        # only keys that WOULD have been installed are
+                        # deferred + unsubscribed; keys already
+                        # replicated/adopted keep their registration
+                        # (unsub would orphan them)
+                        skipped = ks[fresh & bl]
+                        if len(skipped):
+                            surplus.append(skipped)
+                        fresh &= ~bl
+                    ks, pos = ks[fresh], pos[fresh]
+                    if len(ks) == 0:
+                        continue
+                    L = srv.class_lengths[cid]
+                    cs = ab.add_replicas(ks, shard)
+                    took = ks[: len(cs)]
+                    if len(took):
+                        installed += len(took)
+                        rows = _select_flat(flat, offs, lens,
+                                            pos[: len(cs)]).reshape(-1, L)
+                        srv.stores[cid].install_replica_rows(
+                            np.full(len(took), shard, np.int32),
+                            cs.astype(np.int32), rows)
+                        chans = key_channel(took, srv.sync.num_channels)
+                        for k, c in zip(took.tolist(), chans.tolist()):
+                            srv.sync.replicas[c].add((int(k), shard))
+                        srv.sync.stats.replicas_created += len(took)
+                        if srv.tracer is not None:
+                            from ..utils.stats import REPLICA_SETUP
+                            srv.tracer.record(took, REPLICA_SETUP, shard)
+                    if len(cs) < len(ks):  # cache pool full
+                        surplus.append(ks[len(cs):])
+                if installed == 0:
+                    tm.cancel()  # everything deferred or pool-full
         if surplus:
             # the owner registered our interest for keys we could not host:
             # unsubscribe so they stay relocatable
@@ -835,6 +840,9 @@ class GlobalPM:
         srv = self.server
         with srv._lock:
             ab = srv.ab
+            # the refresh replaces replica bases with owner-fresh values:
+            # staged prefetch buffers of these keys go stale
+            srv._prefetch_note(karr)
             for cid, (pos, rows) in class_rows.items():
                 # replicas may have been dropped/upgraded while the round
                 # was in flight; refresh only still-live ones
@@ -976,7 +984,8 @@ class GlobalPM:
         self.unsub(karr, shipped)
         residue_keys: List[np.ndarray] = []
         residue_flat: List[np.ndarray] = []
-        with srv._lock:
+        with srv._lock, srv._topology_mutation() as tm:
+            dropped_any = False
             ab = srv.ab
             for cid, (pos, rows) in class_rows.items():
                 # only replicas whose slot is unchanged since extraction:
@@ -997,6 +1006,7 @@ class GlobalPM:
                 for s in np.unique(sarr[pos]):
                     m = sarr[pos] == s
                     ab.drop_replicas(karr[pos][m], int(s))
+                    dropped_any = True
                     if srv.tracer is not None:
                         from ..utils.stats import REPLICA_DROP
                         srv.tracer.record(karr[pos][m], REPLICA_DROP,
@@ -1005,7 +1015,8 @@ class GlobalPM:
                 c = int(key_channel(np.asarray([k]),
                                     srv.sync.num_channels)[0])
                 srv.sync.replicas[c].discard((int(k), int(s)))
-            srv.topology_version += 1
+            if not dropped_any:
+                tm.cancel()  # every replica was already dropped/upgraded
         if residue_keys:
             self.request_write(np.concatenate(residue_keys),
                                np.concatenate(residue_flat), is_set=False)

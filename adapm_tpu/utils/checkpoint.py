@@ -32,6 +32,25 @@ import numpy as np
 FORMAT_VERSION = 3
 
 
+_launder_fn = None
+
+
+def _launder(x):
+    """Bit-exact copy through a jitted XLA program (see restore_server:
+    a transfer-produced buffer entering the donated chain intermittently
+    segfaults this image's XLA CPU; one extra pool copy at restore
+    frequency is free). jnp.copy, NOT `a + 0`: addition maps -0.0 to
+    +0.0, which would break the exact state round-trip this module
+    promises. The jitted copy is cached so repeated restores share one
+    compiled executable per pool shape."""
+    global _launder_fn
+    import jax
+    import jax.numpy as jnp
+    if _launder_fn is None:
+        _launder_fn = jax.jit(lambda a: jnp.copy(a))
+    return _launder_fn(x)
+
+
 def rank_path(path: str, rank: int) -> str:
     return f"{path}.rank{rank}.npz"
 
@@ -112,11 +131,14 @@ def restore_server(server, path: str) -> None:
     assert int(ck["num_shards"]) == server.num_shards, "shard mismatch"
     assert (ck["value_lengths"] == server.value_lengths).all(), \
         "value-length layout mismatch"
-    with server._lock:
-        # the whole addressbook is rewritten below: bump topology_version
-        # so any concurrently-planned optimistic route (core/kv.py
-        # _plan_pull/_plan_push) fails revalidation instead of dispatching
-        # pre-restore coordinates into the restored pools
+    # the whole addressbook is rewritten below (direct table writes, not
+    # counted ab methods): run under the topology-mutation discipline so
+    # the trailing version bump is the last mutation before the lock
+    # releases, and keep the leading manual bump so any concurrently-
+    # planned optimistic route (core/kv.py _plan_pull/_plan_push) fails
+    # revalidation instead of dispatching pre-restore coordinates into
+    # the restored pools
+    with server._lock, server._topology_mutation():
         server.topology_version += 1
         ab = server.ab
         ab.owner[:] = ck["owner"]
@@ -142,7 +164,15 @@ def restore_server(server, path: str) -> None:
                 assert arr.shape == cur.shape, (
                     f"pool {name}_{cid} geometry mismatch: checkpoint "
                     f"{arr.shape} vs server {cur.shape}")
-                setattr(st, name, jax.device_put(arr, sh))
+                new = jax.device_put(arr, sh)
+                # route the restored pool through an XLA program before
+                # it re-enters the donated-buffer chain: this image's
+                # XLA CPU intermittently SEGFAULTS when a later donating
+                # program (e.g. the first post-restore sync_replicas)
+                # consumes a buffer produced directly by a host->device
+                # transfer (observed ~50% of test_checkpoint sessions,
+                # also on pre-r6 code); an XLA-produced buffer dodges it
+                setattr(st, name, _launder(new))
 
         # rebuild free lists from table occupancy
         for cid in range(len(server.stores)):
@@ -166,7 +196,11 @@ def restore_server(server, path: str) -> None:
             server.glob.owner_hint[:] = ck["owner_hint"]
             server.glob.reloc[:] = ck["reloc"]
             server.glob.interest[:] = ck["interest"]
-        server.topology_version += 1
+    if server.prefetch is not None:
+        # staged pull buffers predate the restore; the version bump
+        # already invalidates them lazily — drop them now to release
+        # their staging-pool rows promptly
+        server.prefetch.invalidate_all()
     server.block()
     if server.glob is not None:
         server.barrier()  # all ranks restored before traffic resumes

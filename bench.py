@@ -41,16 +41,14 @@ import subprocess
 import time
 
 # the adaptive phase runs on 8 virtual CPU shards in the same process;
-# must be set before jax initializes its backends
+# must be set before jax initializes its backends. The collective
+# watchdog flags are probed first: a jaxlib that does not know them
+# ABORTS the process on client init (xla_compat.py).
+from xla_compat import mesh_flags  # noqa: E402
+
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-        # XLA CPU's in-process collective rendezvous kills the process
-        # after 40 s if participants straggle; 8 participants serialized
-        # on a 1-2 core host legitimately take that long on big programs
-        " --xla_cpu_collective_call_warn_stuck_timeout_seconds=120"
-        " --xla_cpu_collective_call_terminate_timeout_seconds=900").strip()
+    os.environ["XLA_FLAGS"] = " ".join([_flags, mesh_flags(8)]).strip()
 
 import sys
 
@@ -73,13 +71,23 @@ def _skewed_keys(rng, n, size):
 
 
 def bench_tpu(E=200_000, R=1_000, d=128, B=4096, N=32, steps=50,
-              warmup=5, dedup_batches=False, scan_steps=1):
+              warmup=5, dedup_batches=False, scan_steps=1,
+              prefetch=False):
     """Returns (triples/sec, server) — the caller reads PM stats.
 
     scan_steps > 1 uses the K-step lax.scan window (runner.run_scan): one
     dispatch trains K steps, with intents signaled a window ahead and the
     K planner rounds driven while the device chews the window — the same
-    PM work per step, dispatch overhead amortized K-fold."""
+    PM work per step, dispatch overhead amortized K-fold.
+
+    prefetch=True runs the SAME per-step loop through the intent-driven
+    prefetch pipeline (SystemOptions.prefetch; core/intent.py): key
+    batches pre-staged on device at intent time, the per-step planner
+    round delegated to the pipeline's background thread so it overlaps
+    the in-flight step, and device table mirrors re-staged by the
+    pipeline after topology changes. The other phases pass
+    prefetch=False explicitly so per-step/scan numbers keep measuring
+    the inline baseline."""
     import adapm_tpu
     from adapm_tpu.config import SystemOptions
     from adapm_tpu.models import make_kge_loss
@@ -89,7 +97,8 @@ def bench_tpu(E=200_000, R=1_000, d=128, B=4096, N=32, steps=50,
     _progress(f"kge phase: building server ({num_keys} keys)")
     srv = adapm_tpu.setup(num_keys, 4 * d,
                           opts=SystemOptions(cache_slots_per_shard=1,
-                                             sync_max_per_sec=0))
+                                             sync_max_per_sec=0,
+                                             prefetch=prefetch))
     w = srv.make_worker(0)
     rng = np.random.default_rng(0)
     # initialize in slabs to bound host memory
@@ -149,15 +158,27 @@ def bench_tpu(E=200_000, R=1_000, d=128, B=4096, N=32, steps=50,
         batches = [batch() for _ in range(4)]
         intent_keys = [np.unique(np.concatenate([b["s"], b["r"], b["o"]]))
                        for b in batches]
+        # prefetch mode: batch key uploads staged ahead of dispatch
+        # (the app loops stage at prepare() time; the rotating bench
+        # batches stage once)
+        staged = [runner.prefetch_keys(b) for b in batches] \
+            if prefetch else None
 
         def pm_step(i):
             # the full app-step shape: intent for the NEXT batch, fused
-            # step, one planner round, clock tick
+            # step, one planner round, clock tick. With prefetch the
+            # round rides the pipeline's background thread (drive_rounds)
+            # and overlaps the step instead of serializing after it.
             nxt = (i + 1) % len(batches)
             w.intent(intent_keys[nxt], w.current_clock + 1,
                      w.current_clock + 2)
-            loss = runner(batches[i % len(batches)], None, 0.1)
-            srv.sync.run_round()
+            if staged is not None:
+                loss = runner(batches[i % len(batches)], None, 0.1,
+                              staged=staged[i % len(batches)])
+                srv.drive_rounds()
+            else:
+                loss = runner(batches[i % len(batches)], None, 0.1)
+                srv.sync.run_round()
             w.advance_clock()
             return loss
 
@@ -178,6 +199,19 @@ def bench_tpu(E=200_000, R=1_000, d=128, B=4096, N=32, steps=50,
 
     for _ in range(warmup):
         pm_step(0)
+    if prefetch and srv.prefetch is not None:
+        # settle before timing: the pipeline's background rounds change
+        # placement (and flip the runner between its compiled
+        # with/without-replica variants) asynchronously — if that compile
+        # lands INSIDE the short timing loop, slope timing subtracts it
+        # from the long loop and fabricates absurd throughput (observed
+        # 17k triples/s on a 3k box). Flush the backlog, step once to
+        # compile whichever variant the settled topology selects, flush
+        # again — then both phases measure the same settled steady state.
+        srv.prefetch.flush()
+        for _ in range(2):
+            pm_step(0)
+        srv.prefetch.flush()
     timed(1)
     _progress("kge phase: timing")
     t_short = timed(steps // 4)
@@ -410,6 +444,26 @@ def _phase_kge():
     return out
 
 
+def _phase_prefetch():
+    # intent-driven prefetch pipeline (r6 tentpole): the per-step loop
+    # with staged key uploads + the planner round on the pipeline's
+    # background executor. Runs under ADAPM_BENCH_SMALL=1 too, so every
+    # degraded/CI bench exercises the pipeline (smoke coverage).
+    sz = _kge_sizes()
+    tput, srv = bench_tpu(steps=16 if sz else 50, warmup=2 if sz else 5,
+                          prefetch=True, **sz)
+    srv.prefetch.flush()
+    out = {"tput": tput,
+           "rounds": srv.sync.stats.rounds,
+           "pipeline": srv.prefetch.report(),
+           "plan_cache": srv._plan_cache.stats()
+           if srv._plan_cache is not None else None}
+    if sz:
+        out["small_sizes"] = sz
+    srv.shutdown()
+    return out
+
+
 def _phase_scan():
     # K-step scan window (VERDICT r3 item 2): one dispatch trains 8 steps
     sz = _kge_sizes()
@@ -460,14 +514,15 @@ def _phase_cpu():
     return {"per_core_triples_per_sec": bench_cpu_torch()}
 
 
-_PHASES = {"probe": _phase_probe, "kge": _phase_kge, "scan": _phase_scan,
+_PHASES = {"probe": _phase_probe, "kge": _phase_kge,
+           "prefetch": _phase_prefetch, "scan": _phase_scan,
            "dedup": _phase_dedup, "pm": _phase_pm, "w2v": _phase_w2v,
            "cpu": _phase_cpu}
 
 # generous per-phase walls: a healthy phase finishes in a fraction of
 # these; a wedged relay burns one wall once, then the driver degrades
-_TIMEOUTS = {"probe": 120, "kge": 1200, "scan": 900, "dedup": 900,
-             "pm": 900, "w2v": 900, "cpu": 600}
+_TIMEOUTS = {"probe": 120, "kge": 1200, "prefetch": 1200, "scan": 900,
+             "dedup": 900, "pm": 900, "w2v": 900, "cpu": 600}
 
 _CPU_ENV = {"JAX_PLATFORMS": "cpu", "ADAPM_PLATFORM": "cpu",
             "ADAPM_BENCH_SMALL": "1"}
@@ -526,7 +581,7 @@ def main():
 
     results: dict = {}
     transients: dict = {}
-    for name in ("kge", "scan", "dedup", "w2v"):
+    for name in ("kge", "prefetch", "scan", "dedup", "w2v"):
         r = _run_phase(name, dev_env)
         if not _ok(r) and dev_env is None:
             # one retry on the chip first: the relay also fails
@@ -566,10 +621,7 @@ def main():
     cores = os.cpu_count() or 1
     pm_env = dict(_CPU_ENV)
     pm_shards = 8 if cores >= 4 else 2
-    pm_env["XLA_FLAGS"] = (
-        f"--xla_force_host_platform_device_count={pm_shards}"
-        " --xla_cpu_collective_call_warn_stuck_timeout_seconds=120"
-        " --xla_cpu_collective_call_terminate_timeout_seconds=900")
+    pm_env["XLA_FLAGS"] = mesh_flags(pm_shards)
     results["pm"] = _run_phase("pm", pm_env)
     results["cpu"] = _run_phase("cpu")
 
@@ -584,6 +636,7 @@ def main():
         return r.get("platform_used"), r.get("small_sizes_used")
 
     tput = phase_val("kge", "tput")
+    tput_pref = phase_val("prefetch", "tput")
     tput_scan = phase_val("scan", "tput")
     tput_unique = phase_val("dedup", "tput")
     w2v = phase_val("w2v", "pairs_per_sec")
@@ -591,6 +644,7 @@ def main():
     # ratios are only meaningful between phases run on the SAME platform
     # at the SAME sizes (a mid-run degrade mixes full-size chip numbers
     # with small CPU ones — comparing those is noise, not a gain)
+    pref_comparable = tput > 0 and phase_ctx("prefetch") == kge_ctx
     scan_comparable = tput > 0 and phase_ctx("scan") == kge_ctx
     dedup_comparable = tput > 0 and phase_ctx("dedup") == kge_ctx
     pm = results["pm"] if _ok(results["pm"]) else {"error": "pm failed"}
@@ -602,6 +656,8 @@ def main():
            if _ok(results["cpu"]) else 0.0)
     baseline = 64.0 * cpu
     best = max(tput, tput_scan) if scan_comparable else tput
+    if pref_comparable:
+        best = max(best, tput_pref)
     kge_on_tpu = _ok(results["kge"]) and \
         results["kge"].get("platform_used") not in ("cpu", None)
     out = {
@@ -609,13 +665,20 @@ def main():
         "value": round(best, 1),
         "unit": "triples/sec through the PM (intent+sync in loop; "
                 "d=128, B=4096, N=32 negs, E=200k, power-law skew; "
-                "best of per-step dispatch and K=8 scan window)",
+                "best of per-step dispatch, intent-driven prefetch "
+                "pipeline, and K=8 scan window)",
         "vs_baseline": (round(best / baseline, 3)
                         if baseline and kge_on_tpu else None),
         "platform": kge_ctx[0] or "none",
         "phase_platforms": {n: phase_ctx(n)[0]
-                            for n in ("kge", "scan", "dedup", "w2v")},
+                            for n in ("kge", "prefetch", "scan", "dedup",
+                                      "w2v")},
         "per_step_triples_per_sec": round(tput, 1),
+        "prefetch_triples_per_sec": round(tput_pref, 1),
+        "prefetch_gain": (round(tput_pref / tput - 1.0, 3)
+                          if pref_comparable else None),
+        "prefetch_pipeline": (results["prefetch"].get("pipeline")
+                              if _ok(results["prefetch"]) else None),
         "scan8_triples_per_sec": round(tput_scan, 1),
         "scan_gain": (round(tput_scan / tput - 1.0, 3)
                       if scan_comparable else None),

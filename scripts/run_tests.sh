@@ -8,5 +8,9 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
+# fast prefetch-pipeline smoke first: a staged-pull/plan-cache regression
+# should fail in seconds, not after the full matrix (the pipeline is also
+# exercised by bench.py's prefetch phase under ADAPM_BENCH_SMALL=1)
+python -m pytest tests/test_prefetch.py -q
 python -m pytest tests/ -q "$@"
 echo "ALL TESTS PASSED"

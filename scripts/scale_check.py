@@ -1,11 +1,10 @@
 import os, sys, time
 os.environ["ADAPM_PLATFORM"] = "cpu"
-os.environ["XLA_FLAGS"] = (
-    "--xla_force_host_platform_device_count=8"
-    " --xla_cpu_collective_call_warn_stuck_timeout_seconds=120"
-    " --xla_cpu_collective_call_terminate_timeout_seconds=900")
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 ".."))
+from xla_compat import mesh_flags  # noqa: E402
+
+os.environ["XLA_FLAGS"] = mesh_flags(8)
 import jax; jax.config.update("jax_platforms", "cpu")
 import numpy as np
 import adapm_tpu

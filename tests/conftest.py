@@ -17,13 +17,18 @@ os.environ["ADAPM_PLATFORM"] = "cpu"  # force CPU even if a TPU plugin is up
 os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-        # XLA CPU's in-process collective rendezvous kills the process
-        # after 40 s if participants straggle; 8 participants serialized
-        # on a 1-2 core host legitimately take that long on big programs
-        " --xla_cpu_collective_call_warn_stuck_timeout_seconds=120"
-        " --xla_cpu_collective_call_terminate_timeout_seconds=900").strip()
+    import sys as _sys
+
+    _sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from xla_compat import mesh_flags
+
+    # 8-virtual-device mesh + (when the installed jaxlib knows them) the
+    # XLA CPU collective watchdog timeouts. The watchdog flags are
+    # probed first: a jaxlib that does not know them ABORTS the process
+    # on client init (xla_compat.py) — this round's image does exactly
+    # that, which is why the r6 seed suite scored 0.
+    os.environ["XLA_FLAGS"] = " ".join([flags, mesh_flags(8)]).strip()
 # persistent compilation cache: amortize XLA compiles across pytest sessions
 os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache")
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.1")

@@ -18,10 +18,11 @@ faulthandler.dump_traceback_later(
 
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ["ADAPM_PLATFORM"] = "cpu"
-os.environ.setdefault(
-    "XLA_FLAGS", "--xla_force_host_platform_device_count=2"
-    " --xla_cpu_collective_call_warn_stuck_timeout_seconds=120"
-    " --xla_cpu_collective_call_terminate_timeout_seconds=900")
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+from xla_compat import mesh_flags  # noqa: E402
+
+os.environ.setdefault("XLA_FLAGS", mesh_flags(2))
 os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache")
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.1")
 os.environ.pop("PYTHONPATH", None)

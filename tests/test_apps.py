@@ -6,7 +6,14 @@ MRR beats random."""
 import numpy as np
 import pytest
 
-FAST = ["--sys.sync.max_per_sec", "0"]  # no sync-rate throttling in tests
+# no sync-rate throttling, and INLINE planner rounds: these tests pin
+# training dynamics (loss/MRR/norm thresholds) at fixed seeds, and the
+# prefetch pipeline's background rounds make round timing — hence
+# replica staleness, hence borderline quality numbers — nondeterministic
+# (observed: the L2 norm-shrink margin flapping across runs). The
+# pipeline itself is covered by tests/test_prefetch.py and the bench's
+# prefetch phase.
+FAST = ["--sys.sync.max_per_sec", "0", "--sys.prefetch", "0"]
 
 
 def test_simple_app():

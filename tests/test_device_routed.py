@@ -173,7 +173,7 @@ def test_w2v_device_routes_matches_host(tmp_path):
             "--dim", "8", "--window", "3", "--negative", "4",
             "--epochs", "3", "--batch_size", "256", "--lr", "0.03",
             "--readahead", "30", "--seed", "11",
-            "--sys.sync.max_per_sec", "0"]
+            "--sys.sync.max_per_sec", "0", "--sys.prefetch", "0"]
     host = w2v.run(w2v.build_parser().parse_args(
         base + ["--no-device_routes"]))
     dev = w2v.run(w2v.build_parser().parse_args(base + ["--device_routes"]))
@@ -289,7 +289,7 @@ def test_mf_device_routes_matches_host():
     base = ["--rows", "48", "--cols", "32", "--nnz", "600", "--rank", "4",
             "--epochs", "5", "--batch_size", "16", "--lr", "0.1",
             "--algorithm", "plain", "--seed", "5",
-            "--sys.sync.max_per_sec", "0"]
+            "--sys.sync.max_per_sec", "0", "--sys.prefetch", "0"]
     host = mf.run(mf.build_parser().parse_args(
         base + ["--no-device_routes"]))
     dev = mf.run(mf.build_parser().parse_args(base + ["--device_routes"]))

@@ -184,7 +184,9 @@ def test_optimistic_plan_revalidation(ctx):
     plans["n"] = 0
     orig_push = s._plan_push
 
-    def racy_plan_push(keys, vals, shard, is_set=False):
+    def racy_plan_push(keys, vals, shard, is_set=False, routes=None):
+        # `routes` (the plan-cached skeleton) is deliberately dropped:
+        # this hook forces a full stale plan either way
         plan = orig_push(keys, vals, shard, is_set=is_set)
         if plans["n"] == 0:
             plans["n"] += 1

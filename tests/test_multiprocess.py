@@ -27,8 +27,8 @@ def run_mp(n, scenario, devices=2, args=(), timeout=300):
     env["PYTHONPATH"] = REPO
     env["JAX_PLATFORMS"] = "cpu"
     env["ADAPM_PLATFORM"] = "cpu"
-    env["XLA_FLAGS"] = (f"--xla_force_host_platform_device_count={devices}"
-                        " --xla_cpu_collective_call_warn_stuck_timeout_seconds=120 --xla_cpu_collective_call_terminate_timeout_seconds=900")
+    from xla_compat import mesh_flags
+    env["XLA_FLAGS"] = mesh_flags(devices)
     # a hung scenario dumps its thread stacks + exits before our timeout
     env["ADAPM_FAULT_T"] = str(max(timeout - 20, 30))
     # oversubscribed CI host: a rank's coordination heartbeat can stall
@@ -206,9 +206,8 @@ def test_mp_elastic_recovery_under_keepalive(tmp_path, monkeypatch):
     monkeypatch.setenv("PYTHONPATH", REPO)
     monkeypatch.setenv("JAX_PLATFORMS", "cpu")
     monkeypatch.setenv("ADAPM_PLATFORM", "cpu")
-    monkeypatch.setenv("XLA_FLAGS",
-                       "--xla_force_host_platform_device_count=2"
-                       " --xla_cpu_collective_call_warn_stuck_timeout_seconds=120 --xla_cpu_collective_call_terminate_timeout_seconds=900")
+    from xla_compat import mesh_flags
+    monkeypatch.setenv("XLA_FLAGS", mesh_flags(2))
     code = launcher.launch_local(
         2, [sys.executable, SCENARIOS, "elastic", path], keepalive=True)
     assert code == 0

@@ -14,7 +14,9 @@ import numpy as np
 import pytest
 
 REF_DATA = "/root/reference/apps/data"
-FAST = ["--sys.sync.max_per_sec", "0"]
+# inline planner rounds for deterministic pinned-quality dynamics (same
+# rationale as tests/test_apps.py FAST)
+FAST = ["--sys.sync.max_per_sec", "0", "--sys.prefetch", "0"]
 
 pytestmark = [
     pytest.mark.parity,

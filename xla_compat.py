@@ -1,0 +1,112 @@
+"""XLA_FLAGS compatibility probing.
+
+XLA's flag parser ABORTS the whole process (parse_flags_from_env.cc
+SIGABRT, not a Python exception) when XLA_FLAGS contains a flag the
+installed jaxlib does not know. The tuning flags this repo sets for the
+CPU test/bench harness (the in-process collective watchdog timeouts) do
+not exist in every jaxlib vintage, so baking them into XLA_FLAGS
+unconditionally kills EVERY test and bench process on such an install —
+observed in this image: `make_cpu_client` aborts before the first test
+runs.
+
+`filter_xla_flags` vets optional flags in a throwaway subprocess (the
+only way to survive the abort) and caches the verdict per jaxlib
+version, so the probe costs one interpreter start per environment, not
+per run.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import subprocess
+import sys
+import tempfile
+from typing import List, Sequence
+
+# flags old enough to be universally safe are not probed
+_ALWAYS_SAFE_PREFIXES = ("--xla_force_host_platform_device_count",)
+
+
+def _cache_path(flags: Sequence[str]) -> str:
+    try:
+        from importlib.metadata import version
+        ver = version("jaxlib")
+    except Exception:  # pragma: no cover - jaxlib always installed here
+        ver = "unknown"
+    h = hashlib.sha1((" ".join(flags)).encode()).hexdigest()[:12]
+    return os.path.join(tempfile.gettempdir(),
+                        f"adapm_xla_flags_{ver}_{h}")
+
+
+def _probe(flags: Sequence[str], timeout: float = 120.0):
+    """True/False: a fresh interpreter could / could not build the CPU
+    client with `flags` in XLA_FLAGS (an unknown flag ABORTS that
+    subprocess, so rc != 0 is a definitive rejection). None: the probe
+    itself failed to produce a verdict (timeout on a loaded host, spawn
+    error) — the caller must not CACHE that as a rejection."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["ADAPM_PLATFORM"] = "cpu"
+    env["XLA_FLAGS"] = " ".join(flags)
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; jax.config.update('jax_platforms', 'cpu'); "
+             "jax.devices()"],
+            env=env, capture_output=True, timeout=timeout)
+        return r.returncode == 0
+    except Exception:
+        return None
+
+
+def filter_xla_flags(flags: Sequence[str]) -> List[str]:
+    """Return the subset of `flags` the installed jaxlib accepts.
+
+    Probes all candidate flags at once (the common case: all supported
+    or the whole same-vintage group missing); on a definitive rejection
+    retries each flag individually. Definitive verdicts are cached under
+    the system temp dir, keyed by jaxlib version + flag set; an
+    inconclusive probe (timeout on a loaded host) conservatively omits
+    the flags for THIS run only — caching it would strip supported
+    watchdog flags forever.
+    """
+    need_probe = [f for f in flags
+                  if not f.startswith(_ALWAYS_SAFE_PREFIXES)]
+    safe = [f for f in flags if f.startswith(_ALWAYS_SAFE_PREFIXES)]
+    if not need_probe:
+        return list(flags)
+    cache = _cache_path(need_probe)
+    if os.path.exists(cache):
+        with open(cache) as f:
+            kept = f.read().split()
+        return safe + [f for f in need_probe if f in kept]
+    verdict = _probe(safe + need_probe)
+    if verdict is None:
+        return safe  # inconclusive: omit but do not cache
+    if verdict:
+        kept = need_probe
+    else:
+        per_flag = {f: _probe(safe + [f]) for f in need_probe}
+        if None in per_flag.values():
+            return safe + [f for f, ok in per_flag.items() if ok]
+        kept = [f for f, ok in per_flag.items() if ok]
+    tmp = cache + f".tmp{os.getpid()}"
+    with open(tmp, "w") as f:  # atomic: concurrent pytest workers race
+        f.write(" ".join(kept))
+    os.replace(tmp, cache)
+    return safe + kept
+
+
+def mesh_flags(devices: int) -> str:
+    """The harness's XLA_FLAGS value for an N-virtual-device CPU mesh:
+    the device-count flag plus — only when the installed jaxlib knows
+    them — the in-process collective watchdog timeouts (XLA CPU kills
+    the process after 40 s if rendezvous participants straggle, which N
+    participants serialized on a 1-2 core host legitimately do on big
+    programs). One probe per environment; every caller (conftest,
+    bench.py, the mp test harness, scripts) shares the cached verdict."""
+    return " ".join(filter_xla_flags([
+        f"--xla_force_host_platform_device_count={devices}",
+        "--xla_cpu_collective_call_warn_stuck_timeout_seconds=120",
+        "--xla_cpu_collective_call_terminate_timeout_seconds=900",
+    ]))

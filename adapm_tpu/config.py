@@ -103,12 +103,31 @@ class SystemOptions:
     # Wikidata5M-sized table filling most of HBM) set close to 1.0
     main_over_alloc: float = 1.25
 
-    # -- observability (sys.stats.*, sys.trace.*)
+    # -- observability (sys.stats.*, sys.trace.*, sys.metrics*; obs/)
     stats_out: Optional[str] = None
     trace_keys: Optional[str] = None
     # per-key access counters (PS_LOCALITY_STATS)
     locality_stats: bool = False
     sync_report_s: float = 10.0      # periodic sync-thread report (0 = off)
+    # unified metrics registry (docs/OBSERVABILITY.md): counters/gauges/
+    # histograms behind Server.metrics_snapshot(). Default ON (<2%
+    # overhead budget on the bench probe phase; guarded by
+    # scripts/metrics_overhead_check.py); --sys.metrics 0 disables the
+    # registry entirely (null metrics, empty snapshot, no reporter import)
+    metrics: bool = True
+    # periodic one-line metrics report every N seconds (0 = off; the
+    # reporter module is only imported when > 0 AND metrics is on)
+    metrics_report_s: float = 0.0
+    # span tracing: begin/end events for named phases, exported as
+    # Chrome trace-event JSON (Perfetto-loadable) at shutdown. Default
+    # off — spans bracket the hot Pull/Push path.
+    trace_spans: bool = False
+    # trace output path (default: <stats_out or cwd>/spans.<rank>.trace.json)
+    trace_spans_out: Optional[str] = None
+    # faulthandler crash dumps with a per-rank file (+ last-open-span
+    # breadcrumb when trace_spans is on) — attributes this image's
+    # intermittent XLA-CPU hard aborts (CHANGES.md r6). Default on.
+    crash_dumps: bool = True
 
     # -- sampling (--sampling.*)
     sampling_scheme: str = "local"   # naive | preloc | pool | local
@@ -167,6 +186,16 @@ class SystemOptions:
                        action="store_true")
         g.add_argument("--sys.sync.report", dest="sys_sync_report",
                        type=float, default=10.0)
+        g.add_argument("--sys.metrics", dest="sys_metrics", type=int,
+                       default=1)
+        g.add_argument("--sys.metrics.report", dest="sys_metrics_report",
+                       type=float, default=0.0)
+        g.add_argument("--sys.trace.spans", dest="sys_trace_spans",
+                       type=int, default=0)
+        g.add_argument("--sys.trace.spans_out",
+                       dest="sys_trace_spans_out", default=None)
+        g.add_argument("--sys.crash_dumps", dest="sys_crash_dumps",
+                       type=int, default=1)
         s = parser.add_argument_group("sampling")
         s.add_argument("--sampling.scheme", dest="sampling_scheme",
                        default="local",
@@ -208,6 +237,11 @@ class SystemOptions:
             trace_keys=args.sys_trace_keys,
             locality_stats=args.sys_stats_locality,
             sync_report_s=args.sys_sync_report,
+            metrics=bool(args.sys_metrics),
+            metrics_report_s=args.sys_metrics_report,
+            trace_spans=bool(args.sys_trace_spans),
+            trace_spans_out=args.sys_trace_spans_out,
+            crash_dumps=bool(args.sys_crash_dumps),
             sampling_scheme=args.sampling_scheme,
             sampling_reuse_factor=args.sampling_reuse,
             sampling_pool_size=args.sampling_pool_size,

@@ -119,17 +119,41 @@ class PlanCache:
     `_plan_push` entirely on a hit.
 
     Thread-safe: the prefetch thread and worker threads share it.
+
+    Hit/miss/stale accounting lives in the metrics registry when one is
+    passed (`plan_cache.*`; docs/OBSERVABILITY.md) — the `hits`/
+    `misses`/`stale` attributes remain as read-only views so the
+    pre-registry accessors keep working.
     """
 
-    def __init__(self, max_entries: int = 64):
+    def __init__(self, max_entries: int = 64, registry=None):
+        from ..obs.metrics import Counter
         self.max_entries = max_entries
         # (kind, shard, fp) -> (keys, topology_version, plan); insertion
         # order doubles as the LRU order
         self._entries: "collections.OrderedDict" = collections.OrderedDict()
         self._lock = threading.Lock()
-        self.hits = 0
-        self.misses = 0
-        self.stale = 0
+        use_reg = registry is not None and registry.enabled
+        mk = (lambda n: registry.counter(f"plan_cache.{n}")) if use_reg \
+            else (lambda n: Counter(f"plan_cache.{n}"))
+        self._c_hits = mk("hits")
+        self._c_misses = mk("misses")
+        self._c_stale = mk("stale")
+        if use_reg:
+            registry.gauge("plan_cache.entries",
+                           fn=lambda: len(self._entries))
+
+    @property
+    def hits(self) -> int:
+        return int(self._c_hits.value)
+
+    @property
+    def misses(self) -> int:
+        return int(self._c_misses.value)
+
+    @property
+    def stale(self) -> int:
+        return int(self._c_stale.value)
 
     @staticmethod
     def fingerprint(keys: np.ndarray) -> int:
@@ -144,17 +168,17 @@ class PlanCache:
         with self._lock:
             ent = self._entries.get(k)
             if ent is None:
-                self.misses += 1
+                self._c_misses.inc()
                 return None
             k0, v0, plan = ent
             if v0 != version:
-                self.stale += 1
+                self._c_stale.inc()
                 del self._entries[k]
                 return None
             if k0.shape != keys.shape or not np.array_equal(k0, keys):
-                self.misses += 1  # fingerprint collision: treat as miss
+                self._c_misses.inc()  # fingerprint collision: as a miss
                 return None
-            self.hits += 1
+            self._c_hits.inc()
             self._entries.move_to_end(k)
             return plan
 
@@ -171,8 +195,9 @@ class PlanCache:
 
     def stats(self) -> Dict[str, int]:
         with self._lock:
-            return {"entries": len(self._entries), "hits": self.hits,
-                    "misses": self.misses, "stale": self.stale}
+            n = len(self._entries)
+        return {"entries": n, "hits": self.hits,
+                "misses": self.misses, "stale": self.stale}
 
 
 class _StagingAbort(Exception):
@@ -260,10 +285,26 @@ class PrefetchScheduler:
         from .store import StagingPool
         self.pools = [StagingPool(opts.prefetch_staging_rows)
                       for _ in server.stores]
-        self.stats = {"staged": 0, "hits": 0, "expired": 0,
-                      "invalidated_write": 0, "invalidated_topology": 0,
-                      "restaged": 0, "rounds_driven": 0, "pool_full": 0,
-                      "evicted": 0}
+        # registry-backed counters behind the pre-registry dict API
+        # (`stats["hits"]` etc. keep working; the registry is the single
+        # source of truth — docs/OBSERVABILITY.md)
+        from ..obs.metrics import CounterGroup
+        reg = server.obs
+        self.stats = CounterGroup(reg, "prefetch", (
+            "staged", "hits", "expired", "invalidated_write",
+            "invalidated_topology", "restaged", "rounds_driven",
+            "pool_full", "evicted"))
+        if reg.enabled:
+            reg.gauge("prefetch.live", fn=lambda: len(self._staged))
+            # StagingPool occupancy (rows now / high-water mark / budget)
+            # summed over the per-class pools — core/store.py
+            reg.gauge("staging.rows_in_use",
+                      fn=lambda: sum(p.rows_in_use for p in self.pools))
+            reg.gauge("staging.rows_hwm",
+                      fn=lambda: max((p.rows_hwm for p in self.pools),
+                                     default=0))
+            reg.gauge("staging.rows_budget",
+                      fn=lambda: sum(p.max_rows for p in self.pools))
 
     # -- producer side (training threads) -----------------------------------
 
@@ -324,6 +365,11 @@ class PrefetchScheduler:
         respect to the server lock — this IS the fast path."""
         if not self._staged:
             return None
+        with self.server._span("prefetch.take"):
+            return self._take_staged_impl(worker, keys)
+
+    def _take_staged_impl(self, worker,
+                          keys: np.ndarray) -> Optional[_StagedPull]:
         fp = PlanCache.fingerprint(keys)
         with self._plock:
             e = self._staged.pop((worker.worker_id, fp), None)
@@ -336,9 +382,9 @@ class PrefetchScheduler:
         if e.version != self.server.topology_version:
             # placement moved since the gather (e.g. a relocation folded
             # a stale replica base into the moved row): not trusted
-            self.stats["invalidated_topology"] += 1
+            self.stats.inc("invalidated_topology")
             return None
-        self.stats["hits"] += 1
+        self.stats.inc("hits")
         return e
 
     # -- invalidation (server write paths; caller holds the server lock) ----
@@ -362,7 +408,7 @@ class PrefetchScheduler:
                     del self._staged[k]
                     self._mask_sub(e.keys)
                     self._release(e)
-                    self.stats["invalidated_write"] += 1
+                    self.stats.inc("invalidated_write")
                     restage.append(e)
         if restage:
             with self._cond:
@@ -461,7 +507,7 @@ class PrefetchScheduler:
             try:
                 for _ in range(rounds):
                     srv.sync.run_round()
-                    self.stats["rounds_driven"] += 1
+                    self.stats.inc("rounds_driven")
                 if rounds:
                     self._refresh_consumers()
                 self._expire()
@@ -474,7 +520,7 @@ class PrefetchScheduler:
                     # deferred poll alive
                     if end < w.current_clock or \
                             w.current_clock == WORKER_FINISHED:
-                        self.stats["expired"] += 1
+                        self.stats.inc("expired")
                         continue
                     window = int(srv.sync.timer.window()[w.worker_id])
                     if start > w.current_clock + window:
@@ -489,7 +535,7 @@ class PrefetchScheduler:
                         # write-invalidation restage must not count the
                         # same eventual pull twice
                         if self._stage_one(w, keys, end, record=False):
-                            self.stats["restaged"] += 1
+                            self.stats.inc("restaged")
             except Exception as e:  # noqa: BLE001 — keep the pipeline up
                 alog(f"[prefetch] background task failed: "
                      f"{type(e).__name__}: {e}")
@@ -517,7 +563,7 @@ class PrefetchScheduler:
                     del self._staged[k]
                     self._mask_sub(e.keys)
                     self._release(e)
-                    self.stats["expired"] += 1
+                    self.stats.inc("expired")
 
     def _stage_one(self, worker, keys: np.ndarray, end: int,
                    record: bool = True) -> bool:
@@ -528,6 +574,12 @@ class PrefetchScheduler:
         srv = self.server
         if len(keys) == 0:
             return False
+        with srv._span("prefetch.stage"):
+            return self._stage_one_impl(worker, keys, end, record)
+
+    def _stage_one_impl(self, worker, keys: np.ndarray, end: int,
+                        record: bool) -> bool:
+        srv = self.server
         from .store import OOB
         shard = worker.shard
         tv = srv.topology_version
@@ -550,7 +602,7 @@ class PrefetchScheduler:
                         o_sh, np.where(use_c, OOB, o_sl).astype(np.int32),
                         c_sh, c_sl, use_c, self.pools[cid])
                     if out is None:  # staging pool budget exhausted
-                        self.stats["pool_full"] += 1
+                        self.stats.inc("pool_full")
                         raise _StagingAbort()
                     vals, rows = out
                     acquired.append((self.pools[cid], rows))
@@ -592,14 +644,14 @@ class PrefetchScheduler:
                     victim = self._staged.pop(mine.pop(0))
                     self._mask_sub(victim.keys)
                     self._release(victim)
-                    self.stats["evicted"] += 1
+                    self.stats.inc("evicted")
                 self._staged[(worker.worker_id, fp)] = entry
                 self._mask_add(keys)
-        self.stats["staged"] += 1
+        self.stats.inc("staged")
         return True
 
     def report(self) -> Dict[str, int]:
-        out = dict(self.stats)
+        out = self.stats.as_dict()
         out["live"] = len(self._staged)
         return out
 

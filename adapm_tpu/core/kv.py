@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import contextlib
 import threading
+import time as _time
 from typing import Dict, List, Optional, Sequence, Tuple, Union
 
 import jax
@@ -33,6 +34,7 @@ import numpy as np
 
 from ..base import CLOCK_MAX, LOCAL, WORKER_FINISHED, MgmtTechniques
 from ..config import SystemOptions
+from ..obs.spans import NULL_SPAN
 from ..parallel.mesh import MeshContext, get_mesh_context
 from .addressbook import Addressbook
 from .store import OOB, ShardedStore
@@ -106,6 +108,40 @@ class Server:
         self.num_procs = control.num_processes()
         self.pid = control.process_id()
 
+        # unified telemetry (adapm_tpu/obs; docs/OBSERVABILITY.md): the
+        # metrics registry every subsystem below reports into, the
+        # optional span tracer, and crash dumps. Built FIRST so
+        # SyncManager / PlanCache / PrefetchScheduler / GlobalPM can
+        # register their metrics at construction.
+        from ..obs import metrics as _obs_metrics
+        self.obs = _obs_metrics.MetricsRegistry(enabled=self.opts.metrics)
+        _obs_metrics.set_global_registry(self.obs)
+        self.spans = None
+        self.crash_dump_path = None
+        bc_path = None
+        if self.opts.crash_dumps:
+            from ..obs.crash import enable_crash_dumps
+            try:
+                self.crash_dump_path, bc_path = enable_crash_dumps(
+                    self.pid, self.opts.stats_out)
+            except OSError:  # unwritable dump dir must not block startup
+                bc_path = None
+        if self.opts.trace_spans:
+            from ..obs.spans import SpanTracer
+            self.spans = SpanTracer(rank=self.pid,
+                                    breadcrumb_path=bc_path)
+        # kv-layer metrics: per-op latency histograms live on the
+        # workers (kv.pull_s/push_s/set_s, shared); registry-side extras:
+        self._c_topo_bumps = self.obs.counter("kv.topology_bumps")
+        self.obs.gauge("kv.topology_version",
+                       fn=lambda: self.topology_version)
+        self.obs.gauge("kv.workers", fn=lambda: len(self._workers))
+        # collective wait-time histograms, observed by the (server-less)
+        # control plane via observe_global (parallel/control.py) and by
+        # Server.barrier below
+        self.obs.histogram("collective.barrier_wait_s")
+        self.obs.histogram("collective.allreduce_wait_s")
+
         self.stores: List[ShardedStore] = []
         for cid, L in enumerate(self.class_lengths):
             cache_slots = self.opts.cache_slots_per_shard
@@ -176,7 +212,8 @@ class Server:
         # topology_version, i.e. they depend on the _topology_mutation
         # discipline above.
         from .intent import PlanCache, PrefetchScheduler
-        self._plan_cache = PlanCache(self.opts.plan_cache_entries) \
+        self._plan_cache = PlanCache(self.opts.plan_cache_entries,
+                                     registry=self.obs) \
             if self.opts.plan_cache_entries > 0 else None
         self.prefetch = PrefetchScheduler(self, self.opts) \
             if self.opts.prefetch else None
@@ -226,6 +263,17 @@ class Server:
             for s in np.unique(owners):
                 self.tracer.record(traced[owners == s], ALLOC, int(s))
 
+        # periodic metrics reporter (--sys.metrics.report N). The import
+        # is INSIDE the gate on purpose: with --sys.metrics 0 the
+        # reporter module must never load (tests assert this).
+        self._reporter = None
+        if self.opts.metrics and self.opts.metrics_report_s > 0:
+            from ..obs.reporter import Reporter
+            self._reporter = Reporter(self.obs,
+                                      self.opts.metrics_report_s,
+                                      rank=self.pid)
+            self._reporter.start()
+
     # -- topology-mutation discipline ----------------------------------------
 
     def _check_topology_discipline(self) -> None:
@@ -269,7 +317,14 @@ class Server:
                         "mutating the addressbook")
                 else:
                     self.topology_version += 1
+                    self._c_topo_bumps.inc()
                     self._ab_mut_acked = self.ab.mutations
+
+    def _span(self, name: str):
+        """Span context for phase `name` — the shared no-op when span
+        tracing is off (one attribute check on the hot path)."""
+        sp = self.spans
+        return NULL_SPAN if sp is None else sp.span(name)
 
     # -- worker management ---------------------------------------------------
 
@@ -393,6 +448,10 @@ class Server:
         version under the lock before dispatching and re-plan on a miss
         (optimistic routing; the reference instead shards per-key locks so
         N worker threads route concurrently, handle.h:1069-1083)."""
+        with self._span("kv.plan_pull"):
+            return self._plan_pull_impl(keys, shard)
+
+    def _plan_pull_impl(self, keys: np.ndarray, shard: int):
         rem = None
         loc_map = None
         if self.glob is not None:
@@ -476,6 +535,12 @@ class Server:
         effects; same lock-free contract as `_plan_pull`. `routes` is an
         optional pre-computed (possibly plan-cached) `_plan_push_routes`
         result for the same (keys, shard, is_set)."""
+        with self._span("kv.plan_push"):
+            return self._plan_push_impl(keys, vals, shard, is_set=is_set,
+                                        routes=routes)
+
+    def _plan_push_impl(self, keys, vals, shard, is_set=False,
+                        routes=None):
         if routes is None:
             routes = self._plan_push_routes(keys, shard, is_set=is_set)
         rem_pos, loc_pos, cls_r = routes
@@ -1034,8 +1099,9 @@ class Server:
         was_running = self._sync_thread is not None
         if was_running:
             self.stop_sync_thread()
-        self.block()
-        control.barrier()
+        with self._span("collective.barrier"):
+            self.block()
+            control.barrier()
         if was_running:
             self.start_sync_thread()
 
@@ -1066,12 +1132,20 @@ class Server:
                 self.sync.run_round()
 
     def shutdown(self) -> None:
+        if self._reporter is not None:
+            self._reporter.stop()
+            self._reporter = None
         if self.prefetch is not None:
             self.prefetch.close()
         self.stop_sync_thread()
         self.block()
         self.sync.close()
         self.write_stats()
+        self.write_trace()
+        if self.spans is not None:
+            self.spans.close()
+        from ..obs import metrics as _obs_metrics
+        _obs_metrics.clear_global_registry(self.obs)
         if self.glob is not None:
             from ..parallel import control
             control.stop_heartbeat()
@@ -1126,8 +1200,89 @@ class Server:
             return []
         from ..parallel import control
         from ..utils.stats import write_stats
-        return write_stats(self.opts.stats_out, control.process_id(),
-                           self.tracer, self.locality)
+        written = write_stats(self.opts.stats_out, control.process_id(),
+                              self.tracer, self.locality)
+        if self.obs.enabled:
+            # the full telemetry snapshot rides along (apps pass
+            # --sys.stats.out; bench embeds the same dict in its JSON)
+            import json
+            import os
+            p = os.path.join(self.opts.stats_out,
+                             f"metrics.{control.process_id()}.json")
+            with open(p, "w") as f:
+                json.dump(self.metrics_snapshot(), f, indent=1,
+                          default=float)
+            written.append(p)
+        return written
+
+    # snapshot sections guaranteed present (possibly empty) in every
+    # metrics_snapshot() — the schema-stability contract tests pin
+    _SNAPSHOT_SECTIONS = ("kv", "prefetch", "plan_cache", "staging",
+                          "sync", "pm", "collective", "fused", "spans")
+
+    def metrics_snapshot(self, drain_device: bool = True) -> Dict:
+        """One structured, JSON-serializable telemetry dict for this
+        process (docs/OBSERVABILITY.md has the metric catalog). Schema:
+        `schema_version`, `metrics_enabled`, and the fixed sections in
+        `_SNAPSHOT_SECTIONS` — always present, `{}`-valued where the
+        subsystem is off or `--sys.metrics 0`. This is the single source
+        of truth the pre-existing ad-hoc surfaces (prefetch stats, plan
+        cache stats, fused locality counts) are folded into; their old
+        accessors remain as views.
+
+        `drain_device=False` skips the fused-runner locality drain (a
+        device readback, ~60 ms on a relay-attached backend) — for
+        periodic callers; end-of-run callers keep the default."""
+        out: Dict = {"schema_version": 1,
+                     "metrics_enabled": bool(self.obs.enabled)}
+        for s in self._SNAPSHOT_SECTIONS:
+            out[s] = {}
+        if not self.obs.enabled:
+            return out
+        for sec, vals in self.obs.snapshot().items():
+            out.setdefault(sec, {}).update(vals)
+        # kv: worker-aggregated op/param counters + the ts=-1 rate
+        agg: Dict[str, int] = {}
+        with self._lock:
+            workers = list(self._workers.values())
+        for w in workers:
+            for k, v in w.stats.items():
+                agg[k] = agg.get(k, 0) + int(v)
+        out["kv"].update(agg)
+        po = agg.get("pull_ops", 0)
+        out["kv"]["local_answer_frac"] = \
+            (agg.get("pull_ops_local", 0) / po) if po else None
+        if drain_device:
+            out["kv"]["locality"] = self.locality_summary()
+        if self.prefetch is not None:
+            out["prefetch"].update(
+                {k: int(v) for k, v in self.prefetch.report().items()})
+        if self._plan_cache is not None:
+            out["plan_cache"].update(self._plan_cache.stats())
+        if self.glob is not None:
+            with self.glob._stats_lock:
+                out["pm"].update({k: int(v)
+                                  for k, v in self.glob.stats.items()})
+                out["pm"]["hops"] = [int(h) for h in self.glob.hops]
+            if self.glob.coll is not None:
+                out["collective"].update(
+                    {f"bsp_{k}": int(v)
+                     for k, v in self.glob.coll.stats.items()})
+        if self.spans is not None:
+            out["spans"].update(self.spans.stats())
+        return out
+
+    def write_trace(self) -> Optional[str]:
+        """Export the span trace (Chrome trace-event JSON, Perfetto-
+        loadable) when --sys.trace.spans is on; returns the path. Called
+        by shutdown; callable earlier for a mid-run trace."""
+        if self.spans is None:
+            return None
+        import os
+        path = self.opts.trace_spans_out or os.path.join(
+            self.opts.stats_out or ".",
+            f"spans.{self.pid}.trace.json")
+        return self.spans.export(path)
 
     def wait_sync(self) -> None:
         """Act on all signalled intents and complete a full sync round
@@ -1237,6 +1392,15 @@ class Worker:
                       "pull_params": 0, "pull_params_local": 0,
                       "push_ops": 0, "push_ops_local": 0,
                       "push_params": 0, "push_params_local": 0}
+        # kv op latency histograms (shared across workers; obs/metrics).
+        # None with --sys.metrics 0 so the hot path skips even the
+        # perf_counter bracketing.
+        if server.obs.enabled:
+            self._h_pull = server.obs.histogram("kv.pull_s", shared=True)
+            self._h_push = server.obs.histogram("kv.push_s", shared=True)
+            self._h_set = server.obs.histogram("kv.set_s", shared=True)
+        else:
+            self._h_pull = self._h_push = self._h_set = None
 
     # -- value plumbing ------------------------------------------------------
 
@@ -1253,6 +1417,22 @@ class Worker:
     def _live_write_futs(self):
         self._write_futs = [f for f in self._write_futs if not f.done()]
         return list(self._write_futs)
+
+    def _instrumented(self, name: str, h, impl, *args):
+        """Latency-histogram + span bracket for a worker op; degrades to
+        a plain call when metrics AND spans are both off."""
+        sp = self.server.spans
+        if h is None and sp is None:
+            return impl(*args)
+        t0 = _time.perf_counter()
+        tok = sp.begin(name) if sp is not None else None
+        try:
+            return impl(*args)
+        finally:
+            if h is not None:
+                h.observe(_time.perf_counter() - t0)
+            if tok is not None:
+                sp.end(name, tok)
 
     def _cached_push_routes(self, keys: np.ndarray, tv: int, is_set: bool):
         """Route skeleton for push/set through the plan cache (values are
@@ -1273,6 +1453,10 @@ class Worker:
         no server lock, no dispatch. Validity (topology unchanged since
         the gather, no intersecting write) was enforced by the pipeline,
         so a staged hit is bit-identical to the pull it replaced."""
+        return self._instrumented("kv.pull", self._h_pull,
+                                  self._pull_op, keys, out)
+
+    def _pull_op(self, keys, out: Optional[np.ndarray]) -> int:
         keys = self._keys(keys)
         srv = self.server
         if srv.prefetch is not None:
@@ -1350,6 +1534,10 @@ class Worker:
     def push(self, keys, vals, asynchronous: bool = True) -> int:
         """Additive push (reference Push, coloc_kv_worker.h:120). vals is a
         flat buffer or [B, L]. Returns ts or LOCAL."""
+        return self._instrumented("kv.push", self._h_push,
+                                  self._push_op, keys, vals)
+
+    def _push_op(self, keys, vals) -> int:
         keys = self._keys(keys)
         vals = np.asarray(vals, dtype=np.float32)
         srv = self.server
@@ -1394,6 +1582,10 @@ class Worker:
 
     def set(self, keys, vals) -> int:
         """Overwrite values (reference Set: non-additive write)."""
+        return self._instrumented("kv.set", self._h_set,
+                                  self._set_op, keys, vals)
+
+    def _set_op(self, keys, vals) -> int:
         import contextlib
         keys = self._keys(keys)
         vals = np.asarray(vals, dtype=np.float32)

@@ -207,6 +207,7 @@ class StagingPool:
         import threading
         self.max_rows = max_rows
         self._rows = 0
+        self._hwm = 0  # occupancy high-water mark (obs: staging.rows_hwm)
         self._lock = threading.Lock()
 
     def try_acquire(self, rows: int) -> bool:
@@ -214,6 +215,8 @@ class StagingPool:
             if self._rows + rows > self.max_rows:
                 return False
             self._rows += rows
+            if self._rows > self._hwm:
+                self._hwm = self._rows
             return True
 
     def release(self, rows: int) -> None:
@@ -224,6 +227,11 @@ class StagingPool:
     @property
     def rows_in_use(self) -> int:
         return self._rows
+
+    @property
+    def rows_hwm(self) -> int:
+        """Highest concurrent row occupancy seen (never resets)."""
+        return self._hwm
 
 
 class ShardedStore:

@@ -85,6 +85,28 @@ class SyncManager:
             rounds_lookahead=opts.timing_rounds_lookahead,
             enabled=opts.time_intent_actions)
         self.stats = SyncStats()
+        # obs wiring (docs/OBSERVABILITY.md): round latency, replica
+        # staleness in clocks, and SyncStats mirrored as callable gauges
+        # so metrics_snapshot()'s sync section is complete without
+        # touching the counters the rest of this file maintains
+        reg = server.obs
+        self._h_round = reg.histogram("sync.round_s")
+        # staleness = worker clocks elapsed since the channel's previous
+        # sync round, observed once per round that refreshed replicas
+        # (i.e. how stale those replicas had been allowed to grow)
+        self._h_staleness = reg.histogram(
+            "sync.replica_staleness_clocks", unit="clocks",
+            bounds=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024))
+        if reg.enabled:
+            for name in ("rounds", "replicas_created", "replicas_dropped",
+                         "relocations", "keys_synced",
+                         "intents_processed"):
+                reg.gauge(f"sync.{name}",
+                          fn=lambda n=name: getattr(self.stats, n))
+        # per-channel min-active-clock at the channel's last sync round
+        # (-1 = never synced yet); feeds _h_staleness
+        self._chan_last_clock = np.full(self.num_channels, -1,
+                                        dtype=np.int64)
         self._next_channel = 0
         self._last_round_t = 0.0
         # collective cadence state (--sys.collective_cadence K): local
@@ -105,6 +127,10 @@ class SyncManager:
         """Drain worker intent queues for intents starting within the
         ActionTimer window (reference registerNewIntents,
         sync_manager.h:257-286); force=True drains everything (WaitSync)."""
+        with self.server._span("sync.drain_intents"):
+            self._drain_intents_impl(force)
+
+    def _drain_intents_impl(self, force: bool) -> None:
         clocks = self.server.worker_clocks()
         self.timer.observe(clocks)
         window = self.timer.window()
@@ -206,6 +232,18 @@ class SyncManager:
         Replicas of remotely-owned keys sync/drop over the DCN channel."""
         reps = self.replicas[channel]
         srv = self.server
+        # staleness-in-clocks: replicas refreshed this round had gone
+        # unrefreshed since the channel's previous round — observe the
+        # min-active-clock delta across that gap
+        mc = self._min_active_clock()
+        if mc is not None:
+            last = int(self._chan_last_clock[channel])
+            self._chan_last_clock[channel] = mc
+            # mc can REGRESS below last when a new worker registers at
+            # clock 0 mid-run; that re-bases the channel (line above)
+            # and must not feed a negative staleness into the histogram
+            if 0 <= last <= mc and reps:
+                self._h_staleness.observe(float(mc - last))
         with srv._lock:  # cross-process handlers mutate replica sets too
             if not reps:
                 return
@@ -278,21 +316,25 @@ class SyncManager:
                 # EndSetup's barrier resumes it. An explicit WaitSync
                 # (force) still acts.
                 return
-            self.drain_intents(force=force_intents)
-            if all_channels:
-                self._sync_all_channels()
-            else:
-                self.sync_channel(self._next_channel)
-                self._next_channel = \
-                    (self._next_channel + 1) % self.num_channels
-            if force_intents and all_channels:
-                # the WaitSync shape: in collective mode this is the
-                # agreed point where every process joins the BSP delta
-                # exchange
-                self._collective_point()
-            else:
-                self._maybe_cadence()
-            self.stats.rounds += 1
+            # round latency measured AFTER the throttle (sleep is policy,
+            # not work) — sync.round_s + the "sync.round" span
+            from ..obs.metrics import timed
+            with timed(self._h_round), self.server._span("sync.round"):
+                self.drain_intents(force=force_intents)
+                if all_channels:
+                    self._sync_all_channels()
+                else:
+                    self.sync_channel(self._next_channel)
+                    self._next_channel = \
+                        (self._next_channel + 1) % self.num_channels
+                if force_intents and all_channels:
+                    # the WaitSync shape: in collective mode this is the
+                    # agreed point where every process joins the BSP delta
+                    # exchange
+                    self._collective_point()
+                else:
+                    self._maybe_cadence()
+                self.stats.rounds += 1
 
     def _sync_all_channels(self) -> None:
         """All channels' rounds. Multi-process, >1 channel: issued

@@ -1,0 +1,25 @@
+"""Runtime telemetry: metrics registry, span tracing, crash breadcrumbs.
+
+One layer every subsystem reports into (see docs/OBSERVABILITY.md):
+
+  - `metrics.MetricsRegistry`: process-wide counters / gauges /
+    bounded-bucket histograms, lock-cheap via per-thread shards merged
+    at snapshot time. Owned by the Server (`Server.obs`); snapshot via
+    `Server.metrics_snapshot()`. `--sys.metrics` (default on).
+  - `spans.SpanTracer`: begin/end events for named phases, exported as
+    Chrome trace-event JSON loadable in Perfetto. `--sys.trace.spans`
+    (default off).
+  - `crash.enable_crash_dumps`: faulthandler with a per-rank dump file,
+    plus a last-open-span breadcrumb so an abort is attributable.
+  - `reporter.Reporter`: optional periodic one-line summary
+    (`--sys.metrics.report`). Imported ONLY when enabled — the hot path
+    never pays for it.
+"""
+from .metrics import (Counter, Gauge, Histogram,  # noqa: F401
+                      MetricsRegistry, get_global_registry,
+                      observe_global, set_global_registry)
+from .spans import NULL_SPAN, SpanTracer  # noqa: F401
+
+__all__ = ["MetricsRegistry", "Counter", "Gauge", "Histogram",
+           "SpanTracer", "NULL_SPAN", "get_global_registry",
+           "set_global_registry", "observe_global"]

@@ -1,0 +1,51 @@
+"""Crash breadcrumbs for hard aborts (segfault / SIGABRT).
+
+This image's XLA CPU intermittently segfaults on pre-existing code paths
+(CHANGES.md r6: checkpoint restore -> first sync_replicas, reproduced on
+the unmodified seed). A Python traceback never appears for those, so:
+
+  - `faulthandler` is enabled with a PER-RANK dump file
+    (`--sys.crash_dumps`, default on): the native-signal handler writes
+    every thread's Python stack into the file as the process dies.
+  - span begins overwrite a last-open-span breadcrumb file
+    (obs/spans.py) when `--sys.trace.spans` is on, naming the phase the
+    process died inside.
+
+Dump files go to `--sys.stats.out` when set, else the system temp dir;
+they are tiny, overwritten per process, and cost nothing until a crash.
+`faulthandler.enable` is idempotent per file; re-enabling (a second
+Server in one process, common in tests) just repoints the handler.
+"""
+from __future__ import annotations
+
+import faulthandler
+import os
+import tempfile
+from typing import Optional, Tuple
+
+_dump_file = None  # keep the handle alive: faulthandler writes by fd
+
+
+def crash_dir(stats_out: Optional[str]) -> str:
+    d = stats_out if stats_out else tempfile.gettempdir()
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def enable_crash_dumps(rank: int,
+                       stats_out: Optional[str]) -> Tuple[str, str]:
+    """Enable faulthandler into a per-rank dump file; returns
+    (dump_path, breadcrumb_path). The breadcrumb file is only written
+    when span tracing is on (SpanTracer owns that fd)."""
+    global _dump_file
+    d = crash_dir(stats_out)
+    dump_path = os.path.join(d, f"adapm_crash.{rank}.{os.getpid()}.log")
+    bc_path = os.path.join(d, f"adapm_breadcrumb.{rank}.{os.getpid()}.txt")
+    if _dump_file is not None:
+        try:
+            _dump_file.close()
+        except OSError:
+            pass
+    _dump_file = open(dump_path, "w")
+    faulthandler.enable(file=_dump_file, all_threads=True)
+    return dump_path, bc_path

@@ -1,0 +1,73 @@
+"""Periodic one-line metrics report (`--sys.metrics.report N` seconds).
+
+IMPORTANT: this module is imported ONLY when the reporter is enabled
+(Server checks `opts.metrics and opts.metrics_report_s > 0` before
+importing) — with `--sys.metrics 0` the hot path never loads it, which
+tests/test_observability.py asserts. Keep it free of side effects at
+import time.
+
+The report reads the REGISTRY only (no fused locstat drain, no device
+sync): a line every N seconds must not force device readbacks the way a
+full `Server.metrics_snapshot()` may."""
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+
+def _fmt(snap: dict) -> str:
+    """Compress a registry snapshot into one line of the load-bearing
+    numbers; unknown sections degrade to counts, never crash."""
+    parts = []
+    kv = snap.get("kv", {})
+    for h in ("pull_s", "push_s"):
+        d = kv.get(h)
+        if isinstance(d, dict) and d.get("count"):
+            parts.append(f"{h[:-2]}={d['count']} "
+                         f"avg={d['avg'] * 1e3:.2f}ms")
+    pf = snap.get("prefetch", {})
+    if pf.get("staged"):
+        tot = pf.get("hits", 0) + pf.get("expired", 0) or 1
+        parts.append(f"staged_hit={pf.get('hits', 0) / tot:.2f}")
+    pc = snap.get("plan_cache", {})
+    att = pc.get("hits", 0) + pc.get("misses", 0) + pc.get("stale", 0)
+    if att:
+        parts.append(f"plan_hit={pc.get('hits', 0) / att:.2f}")
+    sy = snap.get("sync", {})
+    if sy.get("rounds"):
+        parts.append(f"rounds={sy['rounds']} "
+                     f"reloc={sy.get('relocations', 0)} "
+                     f"repl={sy.get('replicas_created', 0)}")
+    return " ".join(parts) or "no activity yet"
+
+
+class Reporter:
+    """Background thread logging `_fmt(registry.snapshot())` every
+    `interval_s`. Daemon; `stop()` joins it."""
+
+    def __init__(self, registry, interval_s: float, rank: int = 0):
+        self.registry = registry
+        self.interval_s = interval_s
+        self.rank = rank
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="adapm-metrics-report")
+        self._thread.start()
+
+    def _loop(self) -> None:
+        from ..utils.log import alog
+        while not self._stop.wait(self.interval_s):
+            alog(f"[metrics r{self.rank}] "
+                 f"{_fmt(self.registry.snapshot())}")
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=5)
+            self._thread = None

@@ -490,6 +490,14 @@ class DeviceRoutedRunner:
         self._loc_host = np.zeros(4, dtype=np.int64)
         self._drain_every = None  # set on first step (needs params/step)
         server._locality_sources.append(self.locality_counts)
+        # obs: drain cadence — how often the device accumulator is
+        # folded to host (each drain is a device sync, so the count and
+        # the computed interval belong in metrics_snapshot()['fused']).
+        # `shared`: several runners per server feed the same counters.
+        self._c_drains = server.obs.counter("fused.locstat_drains",
+                                            shared=True)
+        self._g_drain_every = server.obs.gauge(
+            "fused.locstat_drain_every", unit="steps", shared=True)
         self._mk_kwargs = dict(
             loss_fn=loss_fn, role_class=role_class, role_dim=role_dim,
             shard=shard, frozen_roles=frozen_roles, neg_role=neg_role,
@@ -573,6 +581,7 @@ class DeviceRoutedRunner:
             if self._neg_shape is not None:
                 pps += int(np.prod(self._neg_shape))
             self._drain_every = max(1, 2**30 // max(1, pps))
+            self._g_drain_every.set(self._drain_every)
 
     def _drain_locstat(self) -> None:
         """Fold the device accumulator into the host int64 totals and reset
@@ -584,6 +593,7 @@ class DeviceRoutedRunner:
         self._loc_host += vals
         self._locstat = self.server.ctx.put_replicated(
             np.zeros(4, np.int32))
+        self._c_drains.inc()
 
     def locality_counts(self) -> Dict[str, int]:
         """Cumulative step-program access counts, host-side (the device-

@@ -99,6 +99,10 @@ class CollectiveSync:
         self._first_exchange = True
         self.stats = {"rounds": 0, "iterations": 0, "rows_out": 0,
                       "rows_in": 0}
+        # obs: wall time of each device all-to-all (upload + exchange +
+        # readback) — the ICI/gloo wait the BSP sync path spends per
+        # iteration (docs/OBSERVABILITY.md)
+        self._h_xchg = pm.server.obs.histogram("collective.exchange_s")
 
     # -- the exchange primitive ---------------------------------------------
 
@@ -128,6 +132,11 @@ class CollectiveSync:
         """All-to-all a pytree of [P, B, ...] buffers (leaf[d] = payload
         for process d). Returns same-shaped leaves with leaf[s] = payload
         process s sent here. EVERY process must call this together."""
+        from ..obs.metrics import timed
+        with timed(self._h_xchg):
+            return self._exchange_impl(local_tree)
+
+    def _exchange_impl(self, local_tree):
         import jax
         P = self._P
 
@@ -166,7 +175,8 @@ class CollectiveSync:
         offs = _offsets(lens)
         fresh = np.empty(offs[-1], dtype=np.float32)
         self.stats["rounds"] += 1
-        with _JoinWatchdog(pm.pid, "request_sync"):
+        with pm.server._span("collective.bsp_round"), \
+                _JoinWatchdog(pm.pid, "request_sync"):
             if self._first_exchange:
                 # Align ranks before the FIRST gloo/ICI context creation:
                 # the backend's collective-context init has a hard ~30 s

@@ -91,21 +91,28 @@ def barrier(name: str = "adapm") -> None:
     differently-named barriers interleaved differently across ranks
     still pair correctly — the calling-site tag IS part of the id;
     ADVICE r5 #4). Same-name barriers from two local threads racing each
-    other remain undefined — one caller thread per name."""
+    other remain undefined — one caller thread per name.
+
+    Wait time is observed into the `collective.barrier_wait_s`
+    histogram of the process-default metrics registry (the Server
+    registers it; no-op before a Server exists or with --sys.metrics
+    0)."""
     import jax
     if jax.process_count() == 1:
         return
-    from jax._src import distributed
-    client = distributed.global_state.client
-    if client is not None:
-        # id allocation is atomic; the wait happens outside the lock so
-        # concurrent barriers from different threads both make progress
-        seq = _next_seq(f"barrier/{name}")
-        # generous timeout: a peer may be inside a cold XLA compile
-        client.wait_at_barrier(f"adapm/{name}/{seq}", 600_000)
-        return
-    from jax.experimental import multihost_utils
-    multihost_utils.sync_global_devices(name)
+    from ..obs.metrics import timed
+    with timed("collective.barrier_wait_s"):
+        from jax._src import distributed
+        client = distributed.global_state.client
+        if client is not None:
+            # id allocation is atomic; the wait happens outside the lock
+            # so concurrent barriers from different threads both progress
+            seq = _next_seq(f"barrier/{name}")
+            # generous timeout: a peer may be inside a cold XLA compile
+            client.wait_at_barrier(f"adapm/{name}/{seq}", 600_000)
+            return
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices(name)
 
 
 _hb_stop = None
@@ -317,14 +324,16 @@ def allreduce(values, op: str = "sum", site: str = "ar") -> np.ndarray:
     arr = np.atleast_1d(np.asarray(values, dtype=np.float64))
     if jax.process_count() == 1:
         return arr
-    if _kv_client() is None:  # no coordination service: last resort only
-        from jax.experimental import multihost_utils
-        gathered = np.asarray(multihost_utils.process_allgather(arr))
-    else:
-        parts = _kv_gather(site, _pack_array(arr))
-        gathered = np.stack([
-            _unpack_array(b, arr, f"allreduce[{site}] rank {p}")
-            for p, b in enumerate(parts)])
+    from ..obs.metrics import timed
+    with timed("collective.allreduce_wait_s"):
+        if _kv_client() is None:  # no coordination service: last resort
+            from jax.experimental import multihost_utils
+            gathered = np.asarray(multihost_utils.process_allgather(arr))
+        else:
+            parts = _kv_gather(site, _pack_array(arr))
+            gathered = np.stack([
+                _unpack_array(b, arr, f"allreduce[{site}] rank {p}")
+                for p, b in enumerate(parts)])
     return {"sum": gathered.sum, "mean": gathered.mean,
             "max": gathered.max}[op](axis=0)
 

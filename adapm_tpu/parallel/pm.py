@@ -153,6 +153,13 @@ class GlobalPM:
                       "intents_in": 0, "relocations_out": 0,
                       "relocations_in": 0, "replicas_granted": 0,
                       "syncs_in": 0, "keys_synced_out": 0}
+        # registry counters (obs): ownership transfers the manager
+        # ACCEPTED vs REJECTED as stale by relocation counter — the
+        # per-round planner-churn signal metrics_snapshot()'s pm section
+        # carries alongside the relocations/replications counts above
+        self._c_ou_acc = server.obs.counter("pm.owner_updates_accepted")
+        self._c_ou_stale = server.obs.counter(
+            "pm.owner_updates_rejected_stale")
         # hop histogram: keys SERVED at try 1 / 2 / 3+ of the redirect-
         # retry driver (the reference prints a refresh hop histogram,
         # sync_manager.h:504-519; hops==1 means the location cache or
@@ -1087,6 +1094,8 @@ class GlobalPM:
             ks = keys[newer]
             self.owner_hint[ks] = new_owner
             self.reloc[ks] = counters[newer]
+        self._c_ou_acc.inc(int(newer.sum()))
+        self._c_ou_stale.inc(int(len(keys) - newer.sum()))
         return ("ok",)
 
     # -- lifecycle -----------------------------------------------------------

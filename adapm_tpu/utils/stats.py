@@ -31,6 +31,12 @@ INTENT_START = "INTENT_START"
 INTENT_STOP = "INTENT_STOP"
 RELOCATE = "RELOCATE"
 
+# dump schemas, fixed as module constants so trace TSVs keep a stable,
+# diffable column order across runs (ISSUE 2 satellite; tests pin these)
+TRACE_COLUMNS = ("time", "key", "event", "shard")
+LOCALITY_COLUMNS = ("key", "accesses", "local_accesses",
+                    "sampling_accesses")
+
 
 def parse_trace_spec(spec: str, num_keys: int,
                      ) -> Optional[np.ndarray]:
@@ -85,9 +91,13 @@ class KeyTracer:
             self.events.append((t, int(k), event, shard))
 
     def dump(self, path: str) -> None:
+        # deterministic row order: events are appended by several threads
+        # (worker, sync, prefetch), so the list order varies run to run;
+        # sorting by (time, key, event, shard) makes same-history dumps
+        # diff cleanly
         with open(path, "w") as f:
-            f.write("time\tkey\tevent\tshard\n")
-            for t, k, e, s in self.events:
+            f.write("\t".join(TRACE_COLUMNS) + "\n")
+            for t, k, e, s in sorted(self.events):
                 f.write(f"{t:.6f}\t{k}\t{e}\t{s}\n")
 
 
@@ -121,7 +131,7 @@ class LocalityStats:
     def dump(self, path: str) -> None:
         touched = np.nonzero(self.accesses + self.sampling_accesses)[0]
         with open(path, "w") as f:
-            f.write("key\taccesses\tlocal_accesses\tsampling_accesses\n")
+            f.write("\t".join(LOCALITY_COLUMNS) + "\n")
             for k in touched:
                 f.write(f"{k}\t{self.accesses[k]}\t{self.local[k]}"
                         f"\t{self.sampling_accesses[k]}\n")

@@ -95,10 +95,15 @@ def bench_tpu(E=200_000, R=1_000, d=128, B=4096, N=32, steps=50,
 
     num_keys = E + R
     _progress(f"kge phase: building server ({num_keys} keys)")
+    # ADAPM_TRACE_SPANS=1: emit a Chrome trace-event JSON of the timed
+    # loop (Perfetto-loadable; docs/OBSERVABILITY.md) — the bench twin
+    # of the apps' --sys.trace.spans flag
     srv = adapm_tpu.setup(num_keys, 4 * d,
-                          opts=SystemOptions(cache_slots_per_shard=1,
-                                             sync_max_per_sec=0,
-                                             prefetch=prefetch))
+                          opts=SystemOptions(
+                              cache_slots_per_shard=1,
+                              sync_max_per_sec=0, prefetch=prefetch,
+                              trace_spans=bool(
+                                  os.environ.get("ADAPM_TRACE_SPANS"))))
     w = srv.make_worker(0)
     rng = np.random.default_rng(0)
     # initialize in slabs to bound host memory
@@ -273,7 +278,8 @@ def bench_adaptive_pm(E=20_000, d=32, B=1024, N=8, steps=30):
            "relocations": s.relocations,
            "keys_synced": s.keys_synced,
            "intents_processed": s.intents_processed,
-           "adaptive_steps_per_sec": round(2 * steps / dt, 1)}
+           "adaptive_steps_per_sec": round(2 * steps / dt, 1),
+           "metrics": srv.metrics_snapshot()}
     srv.shutdown()
     return out
 
@@ -437,7 +443,11 @@ def _phase_kge():
                           **sz)
     out = {"tput": tput,
            "rounds": srv.sync.stats.rounds,
-           "intents_processed": srv.sync.stats.intents_processed}
+           "intents_processed": srv.sync.stats.intents_processed,
+           # end-of-run telemetry snapshot (docs/OBSERVABILITY.md): the
+           # BENCH artifact carries hit rates / latency / staleness
+           # alongside throughput
+           "metrics": srv.metrics_snapshot()}
     if sz:
         out["small_sizes"] = sz
     srv.shutdown()
@@ -457,7 +467,8 @@ def _phase_prefetch():
            "rounds": srv.sync.stats.rounds,
            "pipeline": srv.prefetch.report(),
            "plan_cache": srv._plan_cache.stats()
-           if srv._plan_cache is not None else None}
+           if srv._plan_cache is not None else None,
+           "metrics": srv.metrics_snapshot()}
     if sz:
         out["small_sizes"] = sz
     srv.shutdown()

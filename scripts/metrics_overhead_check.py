@@ -1,0 +1,109 @@
+"""Metrics-overhead guard (ISSUE 2 satellite; run by scripts/run_tests.sh).
+
+Times the bench probe-phase shape — a pull/push loop through the full PM
+dispatch path — with the hot-path instrumentation attached vs detached
+and asserts the overhead stays under the budget.
+
+Methodology: ONE server, the instrumentation toggled on its workers and
+sync manager, (off, on) timings back to back, guard on the MEDIAN
+pairwise ratio. Comparing two separately built servers swings >10% on
+this shared 1-2-core container (different pool allocations / memory
+layout), and individual pairs still swing ~0.5x-1.4x, so neither a
+two-server ratio nor a min/max pair statistic can resolve the
+documented <2% budget here. The median of interleaved pairs is robust
+to that noise, and the failure mode this guard exists to catch — an
+accidental lock, O(n) scan, or device sync on the pull/push path —
+costs a MULTIPLE, not percents: it pushes every pair, hence the
+median, far past the 1.15 default threshold
+(ADAPM_METRICS_OVERHEAD_MAX). The 2% budget itself is established by
+the micro-measurement in docs/OBSERVABILITY.md (~2 µs per op), not
+re-measured per commit.
+
+Also performs the duplicate-metric-name integrity check: constructing a
+default Server registers every subsystem's metrics into one registry,
+which raises on any name collision (obs/metrics.py).
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("ADAPM_PLATFORM", "cpu")
+
+import numpy as np  # noqa: E402
+
+
+def build():
+    import adapm_tpu
+    from adapm_tpu.config import SystemOptions
+    srv = adapm_tpu.setup(
+        4096, 32, opts=SystemOptions(sync_max_per_sec=0, prefetch=False))
+    w = srv.make_worker(0)
+    rng = np.random.default_rng(0)
+    w.set(np.arange(4096), rng.normal(
+        size=(4096, 32)).astype(np.float32))
+    batches = [np.unique(rng.integers(0, 4096, 128)) for _ in range(8)]
+    vals = [np.ones((len(b), 32), np.float32) for b in batches]
+    return srv, w, batches, vals
+
+
+def probe(w, batches, vals, steps: int) -> None:
+    for i in range(steps):
+        j = i % len(batches)
+        w.pull_sync(batches[j])
+        w.wait(w.push(batches[j], vals[j]))
+
+
+def set_instrumentation(srv, w, saved, on: bool) -> None:
+    """Attach/detach the hot-path metrics hooks (exactly what
+    --sys.metrics 0 removes from the pull/push path)."""
+    from adapm_tpu.obs.metrics import _NULL
+    if on:
+        (w._h_pull, w._h_push, w._h_set, srv.sync._h_round) = saved
+    else:
+        w._h_pull = w._h_push = w._h_set = None
+        srv.sync._h_round = _NULL
+
+
+def main() -> int:
+    budget = float(os.environ.get("ADAPM_METRICS_OVERHEAD_MAX", "1.15"))
+    steps, repeats = 100, 9
+    srv, w, batches, vals = build()
+    names = srv.obs.names()
+    print(f"[overhead-check] registry catalog: {len(names)} metrics, "
+          f"duplicate-name check passed (enforced at registration)")
+    saved = (w._h_pull, w._h_push, w._h_set, srv.sync._h_round)
+    probe(w, batches, vals, 30)  # warm the jit caches
+    # per-pair (off, on) timings back to back; the guard is the MEDIAN
+    # pairwise ratio (see module docstring for why min/max/two-server
+    # statistics cannot work at this box's noise level)
+    pairs = []
+    for _ in range(repeats):
+        t = {}
+        for on in (False, True):
+            set_instrumentation(srv, w, saved, on)
+            t0 = time.perf_counter()
+            probe(w, batches, vals, steps)
+            t[on] = time.perf_counter() - t0
+        pairs.append(t)
+    set_instrumentation(srv, w, saved, True)
+    srv.shutdown()
+    ratios = sorted(p[True] / p[False] for p in pairs)
+    ratio = ratios[len(ratios) // 2]
+    print(f"[overhead-check] probe {steps} steps x {repeats} pairs: "
+          f"pairwise on/off ratios min {ratios[0]:.3f} / median "
+          f"{ratio:.3f} / max {ratios[-1]:.3f} "
+          f"(guard: median < {budget:.2f}, documented budget < 1.02)")
+    if ratio >= budget:
+        print("[overhead-check] FAILED: metrics registry overhead over "
+              "budget", file=sys.stderr)
+        return 1
+    print("[overhead-check] OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

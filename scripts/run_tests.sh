@@ -12,5 +12,9 @@ cd "$(dirname "$0")/.."
 # should fail in seconds, not after the full matrix (the pipeline is also
 # exercised by bench.py's prefetch phase under ADAPM_BENCH_SMALL=1)
 python -m pytest tests/test_prefetch.py -q
+# metrics-overhead guard + duplicate-metric-name check (ISSUE 2): the
+# registry must stay under its hot-path budget and no two subsystems may
+# register the same metric (docs/OBSERVABILITY.md)
+python scripts/metrics_overhead_check.py
 python -m pytest tests/ -q "$@"
 echo "ALL TESTS PASSED"

@@ -1,12 +1,23 @@
-"""Tracing / locality-stats tests (reference §5: PS_TRACE_KEYS trace events
--> traces.<rank>.tsv, PS_LOCALITY_STATS counters ->
-locality_stats.rank.<r>.tsv, sync shutdown report)."""
+"""Observability tests.
+
+Pre-existing surfaces (reference §5: PS_TRACE_KEYS trace events ->
+traces.<rank>.tsv, PS_LOCALITY_STATS counters ->
+locality_stats.rank.<r>.tsv, sync shutdown report) plus the unified
+telemetry layer (ISSUE 2): metrics registry semantics, snapshot schema
+stability, span traces, crash breadcrumbs, `--sys.metrics 0` inertness,
+and TSV determinism."""
+import json
+import sys
+import threading
+
 import numpy as np
+import pytest
 
 import adapm_tpu
 from adapm_tpu.base import CLOCK_MAX
 from adapm_tpu.config import SystemOptions
-from adapm_tpu.utils.stats import parse_trace_spec
+from adapm_tpu.utils.stats import (LOCALITY_COLUMNS, TRACE_COLUMNS,
+                                   parse_trace_spec)
 
 
 def test_parse_trace_spec():
@@ -88,3 +99,310 @@ def test_sync_report_string():
     rep = srv.sync.report()
     assert "rounds=" in rep and "intents=" in rep
     srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# unified telemetry (ISSUE 2): registry semantics
+# ---------------------------------------------------------------------------
+
+
+def test_counter_sharded_across_threads():
+    from adapm_tpu.obs.metrics import Counter
+    c = Counter("t.c")
+    threads = [threading.Thread(
+        target=lambda: [c.inc() for _ in range(1000)])
+        for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value == 4000
+
+
+def test_histogram_bucket_counts():
+    from adapm_tpu.obs.metrics import Histogram
+    h = Histogram("t.h", bounds=(1.0, 10.0, 100.0))
+    for v in (0.5, 0.1, 1.0, 5.0, 50.0, 500.0):
+        h.observe(v)
+    s = h.snap()
+    # bisect_left: v <= bound lands in that bound's bucket, the last
+    # bucket is the +inf overflow
+    assert s["buckets"] == [3, 1, 1, 1]
+    assert s["count"] == 6 and sum(s["buckets"]) == s["count"]
+    assert s["max"] == 500.0
+    assert abs(s["sum"] - 556.6) < 1e-9
+    assert s["bounds"] == [1.0, 10.0, 100.0]
+
+
+def test_duplicate_metric_name_check():
+    from adapm_tpu.obs.metrics import MetricsRegistry
+    reg = MetricsRegistry()
+    reg.counter("a.b")
+    # two subsystems cannot silently split one counter...
+    with pytest.raises(ValueError):
+        reg.counter("a.b")
+    # ...nor register different kinds under one name, even shared
+    with pytest.raises(ValueError):
+        reg.histogram("a.b", shared=True)
+    # declared-shared metrics are the get-or-create exception
+    c1 = reg.counter("a.c", shared=True)
+    c2 = reg.counter("a.c", shared=True)
+    assert c1 is c2
+
+
+def test_registry_snapshot_sections_and_gauges():
+    from adapm_tpu.obs.metrics import MetricsRegistry
+    reg = MetricsRegistry()
+    reg.counter("kv.ops").inc(3)
+    reg.gauge("staging.occ", fn=lambda: 7)
+    reg.histogram("sync.lat_s").observe(0.01)
+    s = reg.snapshot()
+    assert s["kv"]["ops"] == 3
+    assert s["staging"]["occ"] == 7
+    assert s["sync"]["lat_s"]["count"] == 1
+
+
+def test_counter_group_legacy_dict_api():
+    from adapm_tpu.obs.metrics import CounterGroup, MetricsRegistry
+    reg = MetricsRegistry()
+    g = CounterGroup(reg, "prefetch", ("hits", "staged"))
+    g.inc("hits")
+    g["staged"] += 2          # legacy += path applies the delta
+    assert g["hits"] == 1 and g["staged"] == 2
+    assert dict(g.items()) == {"hits": 1, "staged": 2}
+    assert reg.snapshot()["prefetch"] == {"hits": 1, "staged": 2}
+
+
+# ---------------------------------------------------------------------------
+# unified telemetry: Server.metrics_snapshot end to end
+# ---------------------------------------------------------------------------
+
+
+def _run_instrumented(opts, n_keys=32, vlen=4):
+    srv = adapm_tpu.setup(n_keys, vlen, opts=opts, num_workers=2)
+    w = srv.make_worker(0)
+    keys = np.arange(8, dtype=np.int64)
+    w.set(keys, np.ones((8, vlen), np.float32))
+    w.pull_sync(keys)
+    w.intent(keys, 0, 100)
+    if srv.prefetch is not None:
+        srv.prefetch.flush()
+    w.pull_sync(keys)
+    w.push(keys, np.ones((8, vlen), np.float32))
+    srv.wait_sync()
+    return srv, w
+
+
+def test_metrics_snapshot_schema_stable():
+    srv, w = _run_instrumented(SystemOptions(sync_max_per_sec=0,
+                                             prefetch_pull="always"))
+    snap = srv.metrics_snapshot()
+    # the documented schema contract (docs/OBSERVABILITY.md)
+    assert snap["schema_version"] == 1 and snap["metrics_enabled"]
+    for sec in srv._SNAPSHOT_SECTIONS:
+        assert isinstance(snap[sec], dict), sec
+    # kv: latency histograms + op counters + the ts=-1 rate
+    assert snap["kv"]["pull_s"]["count"] >= 2
+    assert snap["kv"]["push_s"]["count"] >= 1
+    assert snap["kv"]["pull_ops"] >= 2
+    assert 0.0 <= snap["kv"]["local_answer_frac"] <= 1.0
+    # prefetch / plan-cache / staging / sync coverage
+    assert snap["prefetch"]["staged"] >= 1 and snap["prefetch"]["hits"] >= 1
+    assert snap["plan_cache"]["hits"] + snap["plan_cache"]["misses"] >= 1
+    assert snap["staging"]["rows_hwm"] >= 1
+    assert snap["sync"]["rounds"] >= 1
+    assert snap["sync"]["round_s"]["count"] >= 1
+    # JSON-serializable as-is (bench embeds it in the artifact)
+    json.dumps(snap)
+    # schema stability: a second snapshot has the same key structure
+    snap2 = srv.metrics_snapshot()
+    assert set(snap2) == set(snap)
+    for sec in srv._SNAPSHOT_SECTIONS:
+        assert set(snap2[sec]) == set(snap[sec]), sec
+    srv.shutdown()
+
+
+def test_snapshot_is_single_source_for_legacy_views():
+    """The pre-existing ad-hoc surfaces are views over the registry:
+    the numbers agree by construction."""
+    srv, w = _run_instrumented(SystemOptions(sync_max_per_sec=0,
+                                             prefetch_pull="always"))
+    snap = srv.metrics_snapshot()
+    for k, v in srv.prefetch.stats.items():
+        assert snap["prefetch"][k] == v
+    pc = srv._plan_cache.stats()
+    for k in ("hits", "misses", "stale"):
+        assert snap["plan_cache"][k] == pc[k]
+    srv.shutdown()
+
+
+def test_metrics_off_empty_registry_and_no_reporter_import():
+    """--sys.metrics 0: null registry (empty snapshot, no metric names,
+    no latency bracketing) and ZERO imports of the reporter module."""
+    sys.modules.pop("adapm_tpu.obs.reporter", None)
+    srv, w = _run_instrumented(SystemOptions(sync_max_per_sec=0,
+                                             metrics=False))
+    assert not srv.obs.enabled
+    assert srv.obs.names() == []
+    snap = srv.metrics_snapshot()
+    assert snap["metrics_enabled"] is False
+    for sec in srv._SNAPSHOT_SECTIONS:
+        assert snap[sec] == {}, sec
+    assert w._h_pull is None  # hot path skips even the perf_counter
+    # prefetch's own accounting survives metrics-off (standalone view)
+    assert srv.prefetch.stats["hits"] >= 1
+    assert "adapm_tpu.obs.reporter" not in sys.modules
+    srv.shutdown()
+
+
+def test_metrics_reporter_runs_and_stops():
+    srv, w = _run_instrumented(SystemOptions(sync_max_per_sec=0,
+                                             metrics_report_s=0.05))
+    assert srv._reporter is not None
+    from adapm_tpu.obs.reporter import _fmt
+    line = _fmt(srv.obs.snapshot())
+    assert "pull=" in line  # the one-line summary carries kv latency
+    srv.shutdown()
+    assert srv._reporter is None
+
+
+# ---------------------------------------------------------------------------
+# unified telemetry: span traces + crash breadcrumbs
+# ---------------------------------------------------------------------------
+
+
+def test_span_trace_chrome_json(tmp_path):
+    opts = SystemOptions(sync_max_per_sec=0, trace_spans=True,
+                         stats_out=str(tmp_path), prefetch_pull="always")
+    srv, w = _run_instrumented(opts)
+    path = srv.write_trace()
+    srv.shutdown()
+    doc = json.load(open(path))
+    evs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert evs, "no complete events recorded"
+    names = {e["name"] for e in evs}
+    # the instrumented phases of this scenario all appear
+    for must in ("kv.pull", "kv.push", "kv.set", "kv.plan_pull",
+                 "sync.round", "sync.drain_intents", "prefetch.stage",
+                 "prefetch.take"):
+        assert must in names, must
+    for e in evs:
+        assert e["ts"] >= 0 and e["dur"] >= 0 and e["pid"] == 0
+    # thread metadata present (Perfetto track naming)
+    assert any(e.get("ph") == "M" and e.get("name") == "thread_name"
+               for e in doc["traceEvents"])
+
+
+def test_crash_dump_and_breadcrumb(tmp_path):
+    import faulthandler
+    opts = SystemOptions(sync_max_per_sec=0, trace_spans=True,
+                         stats_out=str(tmp_path))
+    srv, w = _run_instrumented(opts)
+    assert faulthandler.is_enabled()
+    import os
+    assert os.path.exists(srv.crash_dump_path)
+    bc = sorted(tmp_path.glob("adapm_breadcrumb.*.txt"))
+    assert bc, "breadcrumb file missing"
+    # the last-open-span breadcrumb names an instrumented phase
+    content = bc[-1].read_text().split()[0]
+    assert content.split(".")[0] in ("kv", "sync", "prefetch",
+                                     "collective")
+    srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# TSV determinism + event ordering (satellites)
+# ---------------------------------------------------------------------------
+
+
+def test_trace_event_ordering_and_column_schema(tmp_path):
+    opts = SystemOptions(trace_keys="all", locality_stats=True,
+                         stats_out=str(tmp_path), sync_max_per_sec=0,
+                         cache_slots_per_shard=16, metrics=False)
+    srv = adapm_tpu.setup(32, 4, opts=opts)
+    w0 = srv.make_worker(0)
+    w1 = srv.make_worker(1)
+    keys = np.arange(8, dtype=np.int64)
+    w0.set(keys, np.ones((8, 4), np.float32))
+    # shared interest with a FINITE window -> replica now, drop later
+    w0.intent(np.array([5]), 0, 1)
+    w1.intent(np.array([5]), 0, 1)
+    srv.wait_sync()
+    w0.pull_sync(np.array([5]))
+    for _ in range(4):  # advance past the intent window
+        w0.advance_clock()
+        w1.advance_clock()
+    srv.wait_sync()  # expiry: INTENT_STOP + REPLICA_DROP
+    files = srv.write_stats()
+    srv.shutdown()
+
+    trace = (tmp_path / "traces.0.tsv").read_text().splitlines()
+    assert trace[0] == "\t".join(TRACE_COLUMNS)
+    rows = [ln.split("\t") for ln in trace[1:]]
+    # deterministic order: rows sorted by (time, key, event, shard)
+    keyed = [(float(t), int(k), e, int(s)) for t, k, e, s in rows]
+    assert keyed == sorted(keyed)
+    by_key = {}
+    for t, k, e, s in keyed:
+        by_key.setdefault(k, []).append((t, e))
+    # ALLOC precedes REPLICA_SETUP for every replicated key
+    for k, evs in by_key.items():
+        times = {e: t for t, e in reversed(evs)}  # first occurrence
+        if "REPLICA_SETUP" in times:
+            assert "ALLOC" in times
+            assert times["ALLOC"] <= times["REPLICA_SETUP"], k
+        # INTENT_START/STOP pairing: stops never exceed starts, and the
+        # first start precedes the first stop
+        starts = [t for t, e in evs if e == "INTENT_START"]
+        stops = [t for t, e in evs if e == "INTENT_STOP"]
+        assert len(stops) <= len(starts)
+        if stops:
+            assert min(starts) <= min(stops)
+    # the finite-window scenario actually produced a paired stop
+    assert any(e == "INTENT_STOP" for _, k, e, _ in keyed)
+
+    loc = (tmp_path / "locality_stats.rank.0.tsv").read_text().splitlines()
+    assert loc[0] == "\t".join(LOCALITY_COLUMNS)
+    ks = [int(ln.split("\t")[0]) for ln in loc[1:]]
+    assert ks == sorted(ks)
+
+
+def test_stopwatch_concurrent_readers():
+    from adapm_tpu.utils import Stopwatch
+    sw = Stopwatch()
+    stop = threading.Event()
+    errs = []
+
+    def hammer():
+        try:
+            while not stop.is_set():
+                sw.start()
+                sw.stop()
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    def read():
+        try:
+            last = -1.0
+            while not stop.is_set():
+                v = sw.elapsed_s
+                assert v >= 0.0
+                # cumulative elapsed never regresses while stopped jobs
+                # only add time
+                assert v >= last - 1e-3
+                last = v
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    threads = [threading.Thread(target=hammer),
+               threading.Thread(target=hammer),
+               threading.Thread(target=read)]
+    for t in threads:
+        t.start()
+    import time
+    time.sleep(0.3)
+    stop.set()
+    for t in threads:
+        t.join()
+    assert not errs, errs
+    assert sw.elapsed_s >= 0.0

@@ -45,6 +45,12 @@ class SystemOptions:
     sync_max_per_sec: float = 1000.0
     sync_pause_ms: float = 0.0
     sync_threshold: float = 0.0      # drop deltas with max-abs below threshold
+    # dirty-delta filtering (core/sync.py sync_channel): rounds ship only
+    # replicas with an unshipped write or a stale base (store.py write
+    # epochs) — exact, so a filtered round reads bit-identically to a
+    # full one. Default on; 0 is the kill switch (re-sync every
+    # intent-live replica every round, the pre-PR-3 behavior).
+    sync_dirty_only: bool = True
 
     # -- collective sync data plane (parallel/collective.py): replica
     #    delta ship + fresh-value refresh ride device all-to-all exchanges
@@ -159,6 +165,8 @@ class SystemOptions:
                        default=0.0)
         g.add_argument("--sys.sync.threshold", dest="sys_sync_threshold",
                        type=float, default=0.0)
+        g.add_argument("--sys.sync.dirty_only", dest="sys_sync_dirty_only",
+                       type=int, default=1)
         g.add_argument("--sys.collective_sync", dest="sys_collective_sync",
                        type=int, default=0)
         g.add_argument("--sys.collective_bucket",
@@ -223,6 +231,7 @@ class SystemOptions:
             sync_max_per_sec=args.sys_sync_max_per_sec,
             sync_pause_ms=args.sys_sync_pause,
             sync_threshold=args.sys_sync_threshold,
+            sync_dirty_only=bool(args.sys_sync_dirty_only),
             collective_sync=bool(args.sys_collective_sync),
             collective_bucket=args.sys_collective_bucket,
             collective_cadence=args.sys_collective_cadence,

@@ -393,7 +393,11 @@ class PrefetchScheduler:
         """Drop (and queue for re-staging) staged batches intersecting
         `keys`. Called from every value-write path BEFORE the write could
         be observed missing: push/set scatter, cross-process applies,
-        replica sync refreshes."""
+        replica sync refreshes. Since the dirty-delta filter (PR 3,
+        core/sync.py), sync rounds invoke this only for replicas they
+        actually ship — clean replicas are skipped whole, so idle
+        staged batches no longer churn through invalidate/re-stage on
+        every planner round."""
         if not self._staged or self._mask is None:
             return
         restage = []

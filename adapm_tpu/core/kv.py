@@ -761,41 +761,32 @@ class Server:
         """Drop this shard's replicas of remotely-owned `keys` (metadata +
         channel registry only; the caller handles delta flushing and the
         owner unsubscription). Caller holds the lock."""
-        from .sync import key_channel
         keys = keys[self.ab.cache_slot[shard, keys] >= 0]
         if len(keys) == 0:
             return
         with self._topology_mutation():
-            chans = key_channel(keys, self.sync.num_channels)
-            for k, c in zip(keys.tolist(), chans.tolist()):
-                self.sync.replicas[c].discard((int(k), shard))
+            self.sync.replica_discard(keys, shard)
             for _, pos in self._group_by_class(keys):
                 self.ab.drop_replicas(keys[pos], shard)
-            self.sync.stats.replicas_dropped += len(keys)
+            self.sync.stats.add(replicas_dropped=len(keys))
 
     def _flush_drop_local_replicas(self, keys: np.ndarray) -> None:
         """Flush pending deltas of all local replicas of `keys` into their
         local main copies and drop the replicas (used before a forced
         cross-process relocation so no delta is lost)."""
-        from .sync import key_channel
-        items = []
-        for s in range(self.num_shards):
-            for k in keys[self.ab.cache_slot[s, keys] >= 0].tolist():
-                items.append((int(k), s))
-        if not items:
+        sh_idx, k_idx = np.nonzero(self.ab.cache_slot[:, keys] >= 0)
+        if len(k_idx) == 0:
             return
-        self._sync_replicas(items)
-        karr = np.fromiter((k for k, _ in items), np.int64, len(items))
-        sarr = np.fromiter((s for _, s in items), np.int32, len(items))
+        karr = keys[k_idx].astype(np.int64)
+        sarr = sh_idx.astype(np.int32)
+        self._sync_replicas(karr, sarr)
         with self._topology_mutation():
-            chans = key_channel(karr, self.sync.num_channels)
-            for (k, s), c in zip(items, chans.tolist()):
-                self.sync.replicas[c].discard((k, s))
+            self.sync.replica_discard(karr, sarr)
             for s in np.unique(sarr):
                 sk = karr[sarr == s]
                 for _, pos in self._group_by_class(sk):
                     self.ab.drop_replicas(sk[pos], int(s))
-            self.sync.stats.replicas_dropped += len(items)
+            self.sync.stats.add(replicas_dropped=len(karr))
 
     # -- planner ops (called by SyncManager) ---------------------------------
 
@@ -837,15 +828,53 @@ class Server:
                 self.tracer.record(out, REPLICA_SETUP, shard)
             return out
 
-    def _sync_replicas(self, items: List[Tuple[int, int]],
+    def _dirty_replica_mask(self, keys: np.ndarray,
+                            shards: np.ndarray) -> np.ndarray:
+        """True per (key, holder-shard) replica iff a sync would change
+        any bit: an unshipped delta write or a base older than the main
+        row (the store-level write epochs; store.py). Cross-process
+        replicas (owner remote, no local main row) report their
+        delta-dirty flag alone — epochs cannot see the remote owner's
+        writes, which is why sync_channel exempts them from the filter;
+        here the flag keeps the dirty_fraction gauge honest in
+        multi-process runs. Pure host reads — safe without the lock (a
+        racing write flips an entry to dirty and is picked up next
+        round; a dropped replica reads as clean and is skipped, which
+        `_sync_replicas` would do anyway)."""
+        out = np.zeros(len(keys), dtype=bool)
+        ab = self.ab
+        for cid, pos in self._group_by_class(keys):
+            ks, ss = keys[pos], shards[pos]
+            cs = ab.cache_slot[ss, ks]
+            o_sh = ab.owner[ks]
+            o_sl = ab.slot[ks]
+            st = self.stores[cid]
+            d = np.zeros(len(ks), dtype=bool)
+            has = np.nonzero(cs >= 0)[0]
+            if len(has) == 0:
+                continue
+            d[has] = st.delta_dirty[ss[has], cs[has]]
+            loc = has[o_sl[has] >= 0]
+            if len(loc):
+                d[loc] |= (st.main_epoch[o_sh[loc], o_sl[loc]]
+                           != st.repl_epoch[ss[loc], cs[loc]])
+            out[pos] = d
+        return out
+
+    def _sync_replicas(self, keys: np.ndarray, shards: np.ndarray,
                        threshold: float = 0.0) -> None:
-        """threshold > 0 leaves small-delta replicas out of the round
+        """Sync replicas given parallel (key, holder-shard) arrays.
+        threshold > 0 leaves small-delta replicas out of the round
         (--sys.sync.threshold); drop/quiesce paths pass 0 so no pending
-        delta is ever lost."""
+        delta is ever lost. Under the lock this does only coordinate
+        revalidation and program ENQUEUE: the per-class device programs
+        are dispatched back-to-back (JAX dispatch is asynchronous), so
+        device execution overlaps the caller's classification of the
+        next channel instead of serializing behind the lock."""
         with self._lock:
             ab = self.ab
-            karr = np.array([k for k, _ in items], dtype=np.int64)
-            sarr = np.array([s for _, s in items], dtype=np.int32)
+            karr = np.ascontiguousarray(keys, dtype=np.int64)
+            sarr = np.ascontiguousarray(shards, dtype=np.int32)
             # a sync refreshes replica bases (and may advance owner rows):
             # staged pull buffers of these keys are no longer what a
             # fresh pull would return
@@ -868,21 +897,21 @@ class Server:
                 self.stores[cid].sync_replicas(ss, r_cs, o_sh, o_sl,
                                                threshold=threshold)
 
-    def _drop_replicas(self, items: List[Tuple[int, int]]) -> None:
+    def _drop_replicas(self, keys: np.ndarray,
+                       shards: np.ndarray) -> None:
         with self._lock:
             # drop only replicas still on record (a DCN handler may have
             # upgraded/dropped some since the caller snapshotted)
-            karr = np.fromiter((k for k, _ in items), np.int64, len(items))
-            sarr = np.fromiter((s for _, s in items), np.int32, len(items))
+            karr = np.ascontiguousarray(keys, dtype=np.int64)
+            sarr = np.ascontiguousarray(shards, dtype=np.int32)
             ok = self.ab.cache_slot[sarr, karr] >= 0
-            items = [it for it, m in zip(items, ok) if m]
-            if not items:
+            if not ok.any():
                 return
             karr, sarr = karr[ok], sarr[ok]
             # flush pending deltas first (base refresh is harmless), then
             # free the slots (reference readAndPotentiallyDropReplica) —
             # grouped per (shard, class), not per key
-            self._sync_replicas(items)
+            self._sync_replicas(karr, sarr)
             with self._topology_mutation():
                 for s in np.unique(sarr):
                     sk = karr[sarr == s]
@@ -909,7 +938,6 @@ class Server:
         pool is full is demoted to a replication attempt (the planner's
         graceful-degradation policy, sync.py _register) rather than
         silently dropped."""
-        from .sync import key_channel
         demoted = np.empty(0, dtype=np.int64)
         n_moved = 0
         with self._lock:
@@ -942,10 +970,7 @@ class Server:
                     rc_sl = np.where(has_rep, cs, OOB).astype(np.int32)
                     rep_keys = moved[has_rep]
                     if len(rep_keys):
-                        chans = key_channel(rep_keys,
-                                            self.sync.num_channels)
-                        for k, c in zip(rep_keys.tolist(), chans.tolist()):
-                            self.sync.replicas[c].discard((k, dest))
+                        self.sync.replica_discard(rep_keys, dest)
                         ab.drop_replicas(rep_keys, dest)
                     self.stores[cid].relocate_rows(
                         old_sh.astype(np.int32), old_sl.astype(np.int32),
@@ -959,10 +984,9 @@ class Server:
                     tm.cancel()  # whole batch demoted: nothing moved
         if len(demoted):
             created = self._create_replicas(demoted, dest)
-            chans = key_channel(created, self.sync.num_channels)
-            for k, c in zip(created.tolist(), chans.tolist()):
-                self.sync.replicas[c].add((k, dest))
-            self.sync.stats.replicas_created += len(created)
+            with self._lock:
+                self.sync.replica_add(created, dest)
+            self.sync.stats.add(replicas_created=len(created))
         return n_moved
 
     # -- lifecycle -----------------------------------------------------------
@@ -1232,8 +1256,14 @@ class Server:
 
         `drain_device=False` skips the fused-runner locality drain (a
         device readback, ~60 ms on a relay-attached backend) — for
-        periodic callers; end-of-run callers keep the default."""
-        out: Dict = {"schema_version": 1,
+        periodic callers; end-of-run callers keep the default.
+
+        schema_version 2 (PR 3): `sync.keys_synced` now counts SHIPPED
+        keys (post-dirty-filter; `sync.keys_shipped` is an alias), the
+        new `sync.keys_considered` counts examined replicas, and the
+        sync section gains `replicas_live`/`dirty_fraction` gauges
+        (total + per channel)."""
+        out: Dict = {"schema_version": 2,
                      "metrics_enabled": bool(self.obs.enabled)}
         for s in self._SNAPSHOT_SECTIONS:
             out[s] = {}

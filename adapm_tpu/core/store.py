@@ -275,6 +275,68 @@ class ShardedStore:
         self.delta = jax.device_put(
             jnp.zeros((S, self.cache_slots, value_length), dtype), sh)
 
+        # -- dirty-delta tracking (host-side, PR 3 tentpole) ---------------
+        # A sync of replica (s, cs) against owner row (o, os) is a
+        # bit-for-bit no-op iff its pending delta is zero AND its base
+        # still equals the main row. Both facts are tracked on the host
+        # so the planner can skip no-op syncs without a device readback:
+        #   main_epoch[o, os]   — bumped (from one per-store counter) by
+        #                         every program that can change a main
+        #                         row's VALUE;
+        #   repl_epoch[s, cs]   — the main row's epoch at the replica's
+        #                         last base refresh;
+        #   delta_dirty[s, cs]  — a delta write landed since that refresh.
+        # dirty  :=  delta_dirty | (main_epoch != repl_epoch).
+        # Conservative only toward syncing (a zero-valued push still
+        # marks dirty); never toward skipping — the invariant the
+        # dirty-vs-full consistency test pins (tests/test_replica_table).
+        self._epoch = 1
+        self.main_epoch = np.zeros((S, self.main_slots), dtype=np.int64)
+        self.repl_epoch = np.zeros((S, self.cache_slots), dtype=np.int64)
+        self.delta_dirty = np.zeros((S, self.cache_slots), dtype=bool)
+
+    def _next_epoch(self) -> int:
+        self._epoch += 1
+        return self._epoch
+
+    def reset_write_tracking(self) -> None:
+        """Conservatively mark everything dirty (checkpoint restore
+        replaces the pools wholesale): the first sync round after a
+        reset re-ships every live replica once, then the filter
+        reconverges."""
+        self._epoch += 1
+        self.main_epoch.fill(self._epoch)
+        self.repl_epoch.fill(0)
+        self.delta_dirty.fill(True)
+
+    def mark_shard_written(self, shard: int) -> None:
+        """Conservative write-tracking for in-program scatters whose row
+        set the host cannot enumerate (device-drawn negatives in the
+        device-routed fused step): every row `shard` holds counts as
+        written. Two contiguous row fills — cheap relative to the step
+        dispatch — at the cost of making the dirty filter inert for
+        this shard's replicas until they resync (exactly the pre-filter
+        behavior, never a missed sync)."""
+        self.main_epoch[shard, :] = self._next_epoch()
+        self.delta_dirty[shard, :] = True
+
+    def mark_routed_writes(self, shard: int, cache_rows: np.ndarray,
+                           owner_sh: np.ndarray,
+                           owner_sl: np.ndarray) -> None:
+        """Exact write-tracking for a fused-step scatter of host-known
+        keys routed by the shared policy (replica delta row where
+        `cache_rows` >= 0, else the owner main row). Caller resolves the
+        coordinates from the addressbook under the server lock — the
+        same tables the device program routes with."""
+        repl = cache_rows >= 0
+        if repl.any():
+            self.delta_dirty[shard, cache_rows[repl]] = True
+        # owner_sl < 0 (process-remote key not yet localized) would wrap
+        # as a negative fancy index — skip; its write lands remotely
+        m = ~repl & (owner_sl >= 0)
+        if m.any():
+            self.main_epoch[owner_sh[m], owner_sl[m]] = self._next_epoch()
+
     def _vals_bucket(self, vals, bucket: int):
         # numpy (uncommitted) for the same reason as pad_bucket: a device-0
         # committed array would be host-resharded by every mesh-jitted op
@@ -311,6 +373,14 @@ class ShardedStore:
 
     def scatter_add(self, o_shard, o_slot, d_shard, d_slot, vals):
         n = len(o_shard)
+        m = np.asarray(o_slot) != OOB
+        if m.any():
+            self.main_epoch[np.asarray(o_shard)[m],
+                            np.asarray(o_slot)[m]] = self._next_epoch()
+        md = np.asarray(d_slot) != OOB
+        if md.any():
+            self.delta_dirty[np.asarray(d_shard)[md],
+                             np.asarray(d_slot)[md]] = True
         a = pad_bucket(n, (o_shard, 0), (o_slot, OOB), (d_shard, 0),
                        (d_slot, OOB), minimum=self.bucket_min)
         v = self._vals_bucket(vals, a[0].shape[0])
@@ -318,6 +388,19 @@ class ShardedStore:
 
     def set_rows(self, o_shard, o_slot, vals, c_shard, c_slot):
         n = len(o_shard)
+        e = self._next_epoch()
+        m = np.asarray(o_slot) != OOB
+        if m.any():
+            self.main_epoch[np.asarray(o_shard)[m],
+                            np.asarray(o_slot)[m]] = e
+        # the writer's refreshed replica carries the set value with a
+        # cleared delta: clean at the new epoch (rows are index-aligned
+        # with the owner rows, so both sides stamp the same e)
+        mc = np.asarray(c_slot) != OOB
+        if mc.any():
+            cs, cl = np.asarray(c_shard)[mc], np.asarray(c_slot)[mc]
+            self.repl_epoch[cs, cl] = e
+            self.delta_dirty[cs, cl] = False
         a = pad_bucket(n, (o_shard, 0), (o_slot, OOB), (c_shard, 0),
                        (c_slot, OOB), minimum=self.bucket_min)
         v = self._vals_bucket(vals, a[0].shape[0])
@@ -326,6 +409,10 @@ class ShardedStore:
 
     def replica_create(self, o_shard, o_slot, c_shard, c_slot):
         n = len(o_shard)
+        # a fresh replica copies the CURRENT main row: clean at the main
+        # row's epoch (no sync needed until someone writes)
+        self.repl_epoch[c_shard, c_slot] = self.main_epoch[o_shard, o_slot]
+        self.delta_dirty[c_shard, c_slot] = False
         a = pad_bucket(n, (o_shard, 0), (o_slot, OOB), (c_shard, 0),
                        (c_slot, OOB), minimum=self.bucket_min)
         self.cache, self.delta = _replica_create(
@@ -334,6 +421,25 @@ class ShardedStore:
     def sync_replicas(self, r_shard, r_cslot, o_shard, o_slot,
                       threshold: float = 0.0):
         n = len(r_shard)
+        if threshold <= 0.0:
+            r_sh, r_cs = np.asarray(r_shard), np.asarray(r_cslot)
+            o_sh, o_sl = np.asarray(o_shard), np.asarray(o_slot)
+            # only owner rows receiving a DIRTY delta advance the epoch:
+            # a clean-but-stale replica's refresh merges a zero delta and
+            # leaves main unchanged — bumping for it would re-stale every
+            # sibling replica and the filter would ping-pong forever
+            dd = self.delta_dirty[r_sh, r_cs]
+            if dd.any():
+                self.main_epoch[o_sh[dd], o_sl[dd]] = self._next_epoch()
+            # refresh: every replica in the batch now equals its main row
+            # (read AFTER the bump; duplicate owner rows agree by
+            # construction — one fresh gather feeds them all)
+            self.repl_epoch[r_sh, r_cs] = self.main_epoch[o_sh, o_sl]
+            self.delta_dirty[r_sh, r_cs] = False
+        # threshold > 0: the ship/hold decision is made ON DEVICE, so the
+        # host cannot know which deltas merged or which bases refreshed —
+        # leave the tracking untouched (replicas stay dirty and are
+        # re-considered every round, the pre-filter behavior)
         a = pad_bucket(n, (r_shard, 0), (r_cslot, OOB), (o_shard, 0),
                        (o_slot, OOB), minimum=self.bucket_min)
         if threshold > 0.0:
@@ -347,6 +453,16 @@ class ShardedStore:
     def relocate_rows(self, old_shard, old_slot, new_shard, new_slot,
                       rc_shard, rc_slot):
         n = len(old_shard)
+        # the moved (possibly delta-merged) main rows get a fresh epoch:
+        # conservative — surviving replicas of the key resync once
+        m = np.asarray(new_slot) != OOB
+        if m.any():
+            self.main_epoch[np.asarray(new_shard)[m],
+                            np.asarray(new_slot)[m]] = self._next_epoch()
+        mr = np.asarray(rc_slot) != OOB
+        if mr.any():  # upgraded replica slot is freed; leave it clean
+            self.delta_dirty[np.asarray(rc_shard)[mr],
+                             np.asarray(rc_slot)[mr]] = False
         a = pad_bucket(n, (old_shard, 0), (old_slot, OOB), (new_shard, 0),
                        (new_slot, OOB), (rc_shard, 0), (rc_slot, OOB),
                        minimum=self.bucket_min)
@@ -365,6 +481,10 @@ class ShardedStore:
 
     def install_replica_rows(self, c_shard, c_slot, vals) -> None:
         n = len(c_shard)
+        # cross-process replica: its base comes from a remote owner, so
+        # local epochs cannot track it (cross replicas are exempt from
+        # the dirty filter — core/sync.py sync_channel)
+        self.delta_dirty[c_shard, c_slot] = False
         a = pad_bucket(n, (c_shard, 0), (c_slot, OOB),
                        minimum=self.bucket_min)
         v = self._vals_bucket(vals, a[0].shape[0])

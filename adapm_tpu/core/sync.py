@@ -18,7 +18,7 @@ per-round payload.
 from __future__ import annotations
 
 import time
-from typing import List, Set, Tuple
+from typing import List, Optional, Tuple
 
 import numpy as np
 
@@ -42,22 +42,173 @@ def key_channel(keys: np.ndarray, num_channels: int) -> np.ndarray:
         np.int32)
 
 
+class ReplicaTable:
+    """One channel's live-replica set as a numpy structure-of-arrays.
+
+    Replaces the `set[(key, shard)]` the planner used to walk with
+    per-key Python: parallel `keys` (int64) / `shards` (int32) columns,
+    a `live` mask, and a LIFO free-list of dead rows — every operation
+    (add / remove / contains / snapshot) is O(batch) vectorized.
+
+    Membership is one fancy-indexed read of a `(num_shards, num_keys)`
+    int32 row-lookup table. The lookup may be SHARED across the channel
+    tables of one SyncManager: a (key, shard) pair lives in exactly one
+    channel (channel = hash(key)), so one table serves all channels
+    without collisions — and int32 at S x K matches the `intent_end`
+    footprint decision above. Lookup entries are validated against the
+    stored key/shard columns on every read, so a stale or foreign row
+    id degrades to "absent", never to a wrong entry.
+
+    Not internally locked: callers mutate under the server lock (the
+    same discipline the replica sets had).
+    """
+
+    GROW_MIN = 1024
+
+    def __init__(self, num_shards: int, num_keys: int,
+                 row_lookup: Optional[np.ndarray] = None):
+        self.num_shards = num_shards
+        self.num_keys = num_keys
+        self._row = row_lookup if row_lookup is not None else \
+            np.full((num_shards, num_keys), -1, dtype=np.int32)
+        cap = self.GROW_MIN
+        self.keys = np.zeros(cap, dtype=np.int64)
+        self.shards = np.zeros(cap, dtype=np.int32)
+        self.live = np.zeros(cap, dtype=bool)
+        self._free = np.empty(cap, dtype=np.int32)
+        self._n_free = 0
+        self._top = 0       # rows [0, _top) have been handed out
+        self._size = 0
+
+    def __len__(self) -> int:
+        return self._size
+
+    @staticmethod
+    def _as_pair(keys, shards) -> Tuple[np.ndarray, np.ndarray]:
+        keys = np.ascontiguousarray(keys, dtype=np.int64).ravel()
+        if np.ndim(shards) == 0:
+            shards = np.full(len(keys), int(shards), dtype=np.int32)
+        else:
+            shards = np.ascontiguousarray(shards, dtype=np.int32).ravel()
+        return keys, shards
+
+    def _valid_rows(self, rows: np.ndarray, keys: np.ndarray,
+                    shards: np.ndarray) -> np.ndarray:
+        """True where the lookup row really is (key, shard) in THIS
+        table (bounds + column match — see class docstring)."""
+        out = np.zeros(len(rows), dtype=bool)
+        idx = np.nonzero((rows >= 0) & (rows < self._top))[0]
+        if len(idx):
+            r = rows[idx]
+            out[idx] = (self.live[r] & (self.keys[r] == keys[idx])
+                        & (self.shards[r] == shards[idx]))
+        return out
+
+    def _grow_cols(self, need: int) -> None:
+        cap = len(self.keys)
+        while cap < need:
+            cap *= 2
+        if cap == len(self.keys):
+            return
+        for name in ("keys", "shards", "live"):
+            old = getattr(self, name)
+            new = np.zeros(cap, dtype=old.dtype)
+            new[: len(old)] = old
+            setattr(self, name, new)
+
+    def add(self, keys, shards) -> int:
+        """Insert (key, shard) pairs; already-present and intra-batch
+        duplicate pairs are ignored. Returns the number inserted."""
+        keys, shards = self._as_pair(keys, shards)
+        if len(keys) == 0:
+            return 0
+        fresh = ~self._valid_rows(self._row[shards, keys], keys, shards)
+        k, s = keys[fresh], shards[fresh]
+        if len(k) == 0:
+            return 0
+        # intra-batch dedup (first occurrence wins)
+        _, first = np.unique(k * np.int64(self.num_shards) + s,
+                             return_index=True)
+        k, s = k[first], s[first]
+        n = len(k)
+        rows = np.empty(n, dtype=np.int64)
+        take = min(n, self._n_free)
+        if take:
+            rows[:take] = self._free[self._n_free - take: self._n_free]
+            self._n_free -= take
+        if n - take:
+            self._grow_cols(self._top + (n - take))
+            rows[take:] = np.arange(self._top, self._top + (n - take))
+            self._top += n - take
+        self.keys[rows] = k
+        self.shards[rows] = s
+        self.live[rows] = True
+        self._row[s, k] = rows
+        self._size += n
+        return n
+
+    def remove(self, keys, shards) -> int:
+        """Remove (key, shard) pairs; absent pairs are ignored. Returns
+        the number removed."""
+        keys, shards = self._as_pair(keys, shards)
+        if len(keys) == 0 or self._size == 0:
+            return 0
+        rows = self._row[shards, keys]
+        rows = np.unique(rows[self._valid_rows(rows, keys, shards)])
+        n = len(rows)
+        if n == 0:
+            return 0
+        self.live[rows] = False
+        self._row[self.shards[rows], self.keys[rows]] = -1
+        if self._n_free + n > len(self._free):
+            cap = len(self._free)
+            while cap < self._n_free + n:
+                cap *= 2
+            new = np.empty(cap, dtype=np.int32)
+            new[: self._n_free] = self._free[: self._n_free]
+            self._free = new
+        self._free[self._n_free: self._n_free + n] = rows
+        self._n_free += n
+        self._size -= n
+        return n
+
+    def contains(self, keys, shards) -> np.ndarray:
+        keys, shards = self._as_pair(keys, shards)
+        return self._valid_rows(self._row[shards, keys], keys, shards)
+
+    def snapshot(self) -> Tuple[np.ndarray, np.ndarray]:
+        """Copies of the live (keys, shards) columns (safe to use after
+        the caller releases whatever lock guarded the mutation)."""
+        rows = np.nonzero(self.live[: self._top])[0]
+        return self.keys[rows], self.shards[rows]
+
+
 class SyncStats:
+    """Planner counters. EVERY bump goes through the locked `add()`
+    helper: rounds run concurrently (per-channel threads, the prefetch
+    pipeline, DCN handlers) and `int +=` is not atomic — the pre-PR 3
+    code locked some sites and not others."""
+
+    FIELDS = ("rounds", "replicas_created", "replicas_dropped",
+              "relocations", "keys_synced", "keys_considered",
+              "intents_processed")
+
     def __init__(self):
-        # concurrent per-channel rounds (_sync_all_channels) bump these
-        # from several threads; int += is not atomic
         import threading
         self.lock = threading.Lock()
-        self.rounds = 0
-        self.replicas_created = 0
-        self.replicas_dropped = 0
-        self.relocations = 0
-        # replicas *considered* by sync rounds; with sync_threshold > 0 the
-        # ship/hold decision is made on device, so held-back small-delta
-        # replicas are still counted here (an exact shipped count would cost
-        # a device readback per round)
-        self.keys_synced = 0
-        self.intents_processed = 0
+        # keys_considered: replicas examined by sync rounds (intent-live,
+        # keep-partition); keys_synced: replicas actually SHIPPED to a
+        # sync program after the dirty-delta filter. With sync_threshold
+        # > 0 the final ship/hold decision is on device, so held-back
+        # small-delta replicas still count as synced here (an exact
+        # on-device count would cost a readback per round).
+        for f in self.FIELDS:
+            setattr(self, f, 0)
+
+    def add(self, **deltas) -> None:
+        with self.lock:
+            for name, n in deltas.items():
+                setattr(self, name, getattr(self, name) + n)
 
 
 class SyncManager:
@@ -76,9 +227,15 @@ class SyncManager:
         # Wikidata5M scale this table is S x 5M — int64 would double its
         # footprint for no range benefit
         self.intent_end = np.full((S, K), -1, dtype=np.int32)
-        # live replicas, partitioned by channel: channel -> set[(key, shard)]
-        self.replicas: List[Set[Tuple[int, int]]] = [
-            set() for _ in range(self.num_channels)]
+        # live replicas, partitioned by channel: one array-native
+        # ReplicaTable per channel, sharing a single (S, K) row-lookup
+        # (a key belongs to exactly one channel, so rows never collide;
+        # same S x K int32 footprint call as intent_end above). Mutated
+        # under the server lock via replica_add/replica_discard.
+        self._replica_row = np.full((S, K), -1, dtype=np.int32)
+        self.replicas: List[ReplicaTable] = [
+            ReplicaTable(S, K, row_lookup=self._replica_row)
+            for _ in range(self.num_channels)]
         self.timer = ActionTimer(
             server.max_workers, alpha=opts.timing_alpha,
             quantile=opts.timing_quantile,
@@ -98,17 +255,34 @@ class SyncManager:
             "sync.replica_staleness_clocks", unit="clocks",
             bounds=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024))
         if reg.enabled:
-            for name in ("rounds", "replicas_created", "replicas_dropped",
-                         "relocations", "keys_synced",
-                         "intents_processed"):
+            for name in SyncStats.FIELDS:
                 reg.gauge(f"sync.{name}",
                           fn=lambda n=name: getattr(self.stats, n))
+            # keys_shipped: the post-dirty-filter name for keys_synced
+            # (docs/OBSERVABILITY.md); both gauges read the same counter
+            reg.gauge("sync.keys_shipped",
+                      fn=lambda: self.stats.keys_synced)
+            # table occupancy + dirty fraction, per channel and total —
+            # host arrays only, no device readback. Best-effort reads
+            # (evaluated without the server lock at snapshot time).
+            reg.gauge("sync.replicas_live",
+                      fn=lambda: sum(len(t) for t in self.replicas))
+            reg.gauge("sync.dirty_fraction",
+                      fn=lambda: self._dirty_fraction(None))
+            for c in range(self.num_channels):
+                reg.gauge(f"sync.replicas_live.c{c}",
+                          fn=lambda c=c: len(self.replicas[c]))
+                reg.gauge(f"sync.dirty_fraction.c{c}",
+                          fn=lambda c=c: self._dirty_fraction(c))
         # per-channel min-active-clock at the channel's last sync round
         # (-1 = never synced yet); feeds _h_staleness
         self._chan_last_clock = np.full(self.num_channels, -1,
                                         dtype=np.int64)
         self._next_channel = 0
         self._last_round_t = 0.0
+        # per-channel (monotonic, dirty, live) memo for the dirty_fraction
+        # gauges — see _dirty_counts
+        self._df_cache: dict = {}
         # collective cadence state (--sys.collective_cadence K): local
         # joins of the BSP exchange must be serialized (two local threads
         # entering the all-to-all concurrently would corrupt the global
@@ -143,23 +317,86 @@ class SyncManager:
                 # ones, or locality decisions go stale
                 relocate_keys, replicate_keys, remote_keys = self._register(
                     w.shard, keys, end)
-                self.stats.intents_processed += len(keys)
+                self.stats.add(intents_processed=len(keys))
                 if len(remote_keys):
                     # keys owned by another process: the OWNER decides
                     # relocate-vs-replicate (reference owner branch,
                     # sync_manager.h:553-739) — ask it over the channel
                     self.server.glob.intent_remote(remote_keys, w.shard, end)
                 if len(relocate_keys):
-                    self.stats.relocations += self.server._relocate_to(
-                        relocate_keys, w.shard)
+                    self.stats.add(relocations=self.server._relocate_to(
+                        relocate_keys, w.shard))
                 if len(replicate_keys):
                     created = self.server._create_replicas(
                         replicate_keys, w.shard)
-                    chans = key_channel(created, self.num_channels)
                     with self.server._lock:
-                        for k, c in zip(created.tolist(), chans.tolist()):
-                            self.replicas[c].add((k, w.shard))
-                    self.stats.replicas_created += len(created)
+                        self.replica_add(created, w.shard)
+                    self.stats.add(replicas_created=len(created))
+
+    # ------------------------------------------------------------------
+    # replica registry (the channel tables; callers hold the server lock)
+    # ------------------------------------------------------------------
+
+    def _replica_op(self, keys: np.ndarray, shards, op: str) -> None:
+        """One vectorized channel grouping (no per-key Python) applying
+        ReplicaTable.`op` per channel; `shards` is a scalar or a per-key
+        array. Caller holds the server lock."""
+        if len(keys) == 0:
+            return
+        keys = np.ascontiguousarray(keys, dtype=np.int64).ravel()
+        chans = key_channel(keys, self.num_channels)
+        sarr = None if np.ndim(shards) == 0 else \
+            np.asarray(shards, dtype=np.int32).ravel()
+        for c in np.unique(chans):
+            m = chans == c
+            getattr(self.replicas[c], op)(
+                keys[m], shards if sarr is None else sarr[m])
+
+    def replica_add(self, keys: np.ndarray, shards) -> None:
+        """Register live replicas into their channels' tables. Caller
+        holds the server lock."""
+        self._replica_op(keys, shards, "add")
+
+    def replica_discard(self, keys: np.ndarray, shards) -> None:
+        """Unregister replicas (absent pairs are ignored, matching the
+        sets' discard semantics). Caller holds the server lock."""
+        self._replica_op(keys, shards, "remove")
+
+    def replica_clear(self) -> None:
+        """Drop every registration (checkpoint restore rebuilds from the
+        addressbook). Caller holds the server lock."""
+        S, K = self.intent_end.shape
+        self._replica_row.fill(-1)
+        self.replicas = [ReplicaTable(S, K, row_lookup=self._replica_row)
+                         for _ in range(self.num_channels)]
+
+    def _dirty_counts(self, channel: int) -> Tuple[int, int]:
+        """(dirty, live) for one channel, memoized briefly: one
+        metrics_snapshot() evaluates the total gauge AND every
+        per-channel gauge, and without the memo each full-table pass
+        would run twice per snapshot (matters at ~1e5 live replicas)."""
+        now = time.monotonic()
+        ent = self._df_cache.get(channel)
+        if ent is not None and now - ent[0] < 0.25:
+            return ent[1], ent[2]
+        t = self.replicas[channel]
+        dirty = total = 0
+        if len(t):
+            keys, shards = t.snapshot()
+            total = len(keys)
+            if total:
+                dirty = int(self.server._dirty_replica_mask(
+                    keys, shards).sum())
+        self._df_cache[channel] = (now, dirty, total)
+        return dirty, total
+
+    def _dirty_fraction(self, channel: Optional[int]) -> float:
+        """Fraction of live replicas with unshipped writes (channel, or
+        all channels for None). Best-effort lock-free gauge read."""
+        chans = range(self.num_channels) if channel is None else (channel,)
+        counts = [self._dirty_counts(c) for c in chans]
+        total = sum(t for _, t in counts)
+        return sum(d for d, _ in counts) / total if total else 0.0
 
     def _register(self, shard: int, keys: np.ndarray,
                   end: int) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
@@ -229,9 +466,17 @@ class SyncManager:
     def sync_channel(self, channel: int) -> None:
         """Refresh replicas with active intent; flush+drop expired ones
         (reference readAndPotentiallyDropReplica, handle.h:601-662).
-        Replicas of remotely-owned keys sync/drop over the DCN channel."""
-        reps = self.replicas[channel]
+        Replicas of remotely-owned keys sync/drop over the DCN channel.
+
+        Lock discipline (PR 3 tentpole): the server lock brackets only
+        the table snapshot here and the coordinate-revalidation +
+        program-enqueue inside `_sync_replicas`/`_drop_replicas` — the
+        keep/drop/cross partition, the dirty-delta filter, and the
+        device execution itself all run outside it, so worker dispatch
+        and the next channel's classification overlap this channel's
+        device work instead of queueing behind the round."""
         srv = self.server
+        table = self.replicas[channel]
         # staleness-in-clocks: replicas refreshed this round had gone
         # unrefreshed since the channel's previous round — observe the
         # min-active-clock delta across that gap
@@ -242,63 +487,87 @@ class SyncManager:
             # mc can REGRESS below last when a new worker registers at
             # clock 0 mid-run; that re-bases the channel (line above)
             # and must not feed a negative staleness into the histogram
-            if 0 <= last <= mc and reps:
+            if 0 <= last <= mc and len(table):
                 self._h_staleness.observe(float(mc - last))
-        with srv._lock:  # cross-process handlers mutate replica sets too
-            if not reps:
+        with srv._lock:  # snapshot only (DCN handlers mutate tables too)
+            if len(table) == 0:
                 return
-            items = list(reps)
-            cross_mask = (srv.ab.owner[np.fromiter(
-                (k for k, _ in items), np.int64, len(items))] < 0) \
+            keys, shards = table.snapshot()
+            cross = (srv.ab.owner[keys] < 0).astype(np.uint8) \
                 if srv.glob is not None else None
         min_clocks = srv.shard_min_clocks()
-        if srv._native is not None:
-            karr = np.fromiter((k for k, _ in items), np.int64, len(items))
-            sarr = np.fromiter((s for _, s in items), np.int32, len(items))
-            keep_mask = np.empty(len(items), np.uint8)
-            srv._native.adapm_replica_scan(
-                karr, sarr, len(items), self.intent_end.ravel(),
-                np.ascontiguousarray(min_clocks, np.int64),
-                srv.num_keys, keep_mask)
-        else:
-            keep_mask = np.fromiter(
-                (self.intent_end[s, k] >= min_clocks[s] for k, s in items),
-                np.uint8, len(items))
-        if cross_mask is None:
-            keep = [it for it, m in zip(items, keep_mask) if m]
-            drop = [it for it, m in zip(items, keep_mask) if not m]
-            keep_x = drop_x = []
-        else:
-            keep, drop, keep_x, drop_x = [], [], [], []
-            for it, m, x in zip(items, keep_mask, cross_mask):
-                (keep_x if x else keep).append(it) if m else \
-                    (drop_x if x else drop).append(it)
-        if keep:
-            srv._sync_replicas(keep, threshold=self.opts.sync_threshold)
-            with self.stats.lock:
-                self.stats.keys_synced += len(keep)
-        if keep_x and not self.opts.collective_sync:
+        keep_l, keep_x, drop_l, drop_x = self._scan_partition(
+            keys, shards, cross, min_clocks)
+        self.stats.add(keys_considered=len(keep_l) + len(keep_x))
+        if len(keep_l):
+            kk, ks = keys[keep_l], shards[keep_l]
+            if self.opts.sync_dirty_only:
+                # dirty-delta filter: gather-and-ship only replicas with
+                # an unshipped write or a stale base (store.py write
+                # epochs). Exact, not heuristic — a clean replica's sync
+                # program is a bit-for-bit no-op (delta == 0 and cache
+                # == main), so skipping it cannot change any read.
+                dirty = srv._dirty_replica_mask(kk, ks)
+                if dirty.any() and not dirty.all():
+                    # sibling propagation: a dirty replica's merge
+                    # advances the shared main row DURING this round, so
+                    # its key's other replicas must ride the same fused
+                    # program to pick up the post-merge value (a full
+                    # round refreshes them in one program; judging them
+                    # against the PRE-merge main would leave them one
+                    # round stale). All replicas of a key hash to this
+                    # channel, so the batch is self-contained.
+                    dirty |= np.isin(kk, kk[dirty])
+                kk, ks = kk[dirty], ks[dirty]
+            if len(kk):
+                srv._sync_replicas(kk, ks,
+                                   threshold=self.opts.sync_threshold)
+                self.stats.add(keys_synced=len(kk))
+        if len(keep_x) and not self.opts.collective_sync:
             # collective mode: cross-process deltas accumulate and ship in
-            # the BSP exchange at the next WaitSync/quiesce point
-            srv.glob.sync_replicas(keep_x)
-            with self.stats.lock:
-                self.stats.keys_synced += len(keep_x)
-        if drop or drop_x:
-            if srv.tracer is not None:
-                from ..utils.stats import INTENT_STOP
-                for k, s in drop + drop_x:
-                    srv.tracer.record(k, INTENT_STOP, s)
-        if drop:
-            srv._drop_replicas(drop)
+            # the BSP exchange at the next WaitSync/quiesce point. Cross
+            # replicas are exempt from the dirty filter: their owner's
+            # writes are invisible to local epochs, and the DCN round is
+            # also how they OBSERVE remote pushes.
+            srv.glob.sync_replicas(keys[keep_x], shards[keep_x])
+            self.stats.add(keys_synced=len(keep_x))
+        if (len(drop_l) or len(drop_x)) and srv.tracer is not None:
+            from ..utils.stats import INTENT_STOP
+            dk = np.concatenate([keys[drop_l], keys[drop_x]])
+            ds = np.concatenate([shards[drop_l], shards[drop_x]])
+            for s in np.unique(ds):
+                srv.tracer.record(dk[ds == s], INTENT_STOP, int(s))
+        if len(drop_l):
+            dk, ds = keys[drop_l], shards[drop_l]
+            srv._drop_replicas(dk, ds)
             with srv._lock:
-                for item in drop:
-                    reps.discard(item)
-            with self.stats.lock:
-                self.stats.replicas_dropped += len(drop)
-        if drop_x:
-            srv.glob.drop_replicas(drop_x)  # discards from the channel set
-            with self.stats.lock:
-                self.stats.replicas_dropped += len(drop_x)
+                self.replica_discard(dk, ds)
+            self.stats.add(replicas_dropped=len(dk))
+        if len(drop_x):
+            # discards from the channel tables itself
+            srv.glob.drop_replicas(keys[drop_x], shards[drop_x])
+            self.stats.add(replicas_dropped=len(drop_x))
+
+    def _scan_partition(self, keys: np.ndarray, shards: np.ndarray,
+                        cross: Optional[np.ndarray],
+                        min_clocks: np.ndarray):
+        """Partition one channel snapshot into (keep_local, keep_cross,
+        drop_local, drop_cross) index arrays: keep iff the holder
+        shard's intent horizon is still active. One native pass
+        (adapm_replica_scan2) or its vectorized numpy equivalent —
+        never per-key Python."""
+        srv = self.server
+        if srv._native is not None:
+            from ..native import replica_scan_partition
+            return replica_scan_partition(
+                srv._native, keys, shards, self.intent_end,
+                np.ascontiguousarray(min_clocks, np.int64),
+                srv.num_keys, cross)
+        keep = self.intent_end[shards, keys] >= min_clocks[shards]
+        x = np.zeros(len(keys), dtype=bool) if cross is None \
+            else cross.astype(bool)
+        return (np.nonzero(keep & ~x)[0], np.nonzero(keep & x)[0],
+                np.nonzero(~keep & ~x)[0], np.nonzero(~keep & x)[0])
 
     def run_round(self, force_intents: bool = False,
                   all_channels: bool = False) -> None:
@@ -334,7 +603,7 @@ class SyncManager:
                     self._collective_point()
                 else:
                     self._maybe_cadence()
-                self.stats.rounds += 1
+                self.stats.add(rounds=1)
 
     def _sync_all_channels(self) -> None:
         """All channels' rounds. Multi-process, >1 channel: issued
@@ -388,11 +657,13 @@ class SyncManager:
         quiescing."""
         srv = self.server
         with srv._lock:
-            items = [it for c in range(self.num_channels)
-                     for it in self.replicas[c]
-                     if srv.ab.owner[it[0]] < 0]
-        all_q = srv.glob.collective_sync(items, quiescing=quiescing)
-        self.stats.keys_synced += len(items)
+            parts = [t.snapshot() for t in self.replicas]
+            karr = np.concatenate([k for k, _ in parts])
+            sarr = np.concatenate([s for _, s in parts])
+            m = srv.ab.owner[karr] < 0
+            karr, sarr = karr[m], sarr[m]
+        all_q = srv.glob.collective_sync(karr, sarr, quiescing=quiescing)
+        self.stats.add(keys_synced=len(karr), keys_considered=len(karr))
         return all_q
 
     def _min_active_clock(self):
@@ -484,23 +755,27 @@ class SyncManager:
         self.drain_intents(force=True)
         for c in range(self.num_channels):
             with srv._lock:
-                reps = list(self.replicas[c])
-            if not reps:
-                continue
-            if srv.glob is not None:
-                karr = np.fromiter((k for k, _ in reps), np.int64, len(reps))
-                with srv._lock:
-                    cross = srv.ab.owner[karr] < 0
-                local = [it for it, x in zip(reps, cross) if not x]
-                remote = [it for it, x in zip(reps, cross) if x]
+                if len(self.replicas[c]) == 0:
+                    continue
+                keys, shards = self.replicas[c].snapshot()
+                cross = (srv.ab.owner[keys] < 0) \
+                    if srv.glob is not None else None
+            if cross is not None:
+                lk, ls = keys[~cross], shards[~cross]
+                rk, rs = keys[cross], shards[cross]
             else:
-                local, remote = reps, []
-            if local:
-                srv._sync_replicas(local)
-                self.stats.keys_synced += len(local)
-            if remote and not self.opts.collective_sync:
-                srv.glob.sync_replicas(remote)
-                self.stats.keys_synced += len(remote)
+                lk, ls = keys, shards
+                rk = rs = np.empty(0, dtype=np.int64)
+            if len(lk):
+                # unconditional flush: quiesce bypasses the dirty filter
+                # (and sync_threshold) so no pending delta is ever lost
+                srv._sync_replicas(lk, ls)
+                self.stats.add(keys_synced=len(lk),
+                               keys_considered=len(lk))
+            if len(rk) and not self.opts.collective_sync:
+                srv.glob.sync_replicas(rk, rs)
+                self.stats.add(keys_synced=len(rk),
+                               keys_considered=len(rk))
         # collective mode: one BSP exchange covers every cross replica
         # (joined by all processes, items or not)
         self._collective_point()
@@ -510,7 +785,9 @@ class SyncManager:
         s = self.stats
         out = (f"sync: rounds={s.rounds} intents={s.intents_processed} "
                f"replicas+={s.replicas_created} -={s.replicas_dropped} "
-               f"relocations={s.relocations} keys_synced={s.keys_synced}")
+               f"relocations={s.relocations} "
+               f"keys_shipped={s.keys_synced}/"
+               f"considered={s.keys_considered}")
         if self.server.glob is not None:
             out += " | " + self.server.glob.report()
         return out

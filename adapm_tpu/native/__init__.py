@@ -111,6 +111,10 @@ def get_lib() -> Optional[ctypes.CDLL]:
         lib.adapm_replica_scan.restype = ctypes.c_int64
         lib.adapm_replica_scan.argtypes = [
             i64p, i32p, ctypes.c_int64, i32p, i64p, ctypes.c_int64, u8p]
+        lib.adapm_replica_scan2.restype = None
+        lib.adapm_replica_scan2.argtypes = [
+            i64p, i32p, ctypes.c_int64, i32p, i64p, ctypes.c_int64, u8p,
+            i64p, i64p, i64p, i64p, i64p]
         _lib = lib
         return _lib
 
@@ -137,3 +141,27 @@ def route(lib, keys: np.ndarray, owner: np.ndarray, slot: np.ndarray,
         raise IndexError(
             f"key {bad} is outside the key range [0, {num_keys})")
     return o_sh, o_sl, c_sh, c_sl, use_c.astype(bool), int(n_remote), local
+
+
+def replica_scan_partition(lib, keys: np.ndarray, shards: np.ndarray,
+                           intent_end: np.ndarray, min_clock: np.ndarray,
+                           num_keys: int, cross):
+    """ctypes wrapper for adapm_replica_scan2: partition a channel
+    snapshot into (keep_local, keep_cross, drop_local, drop_cross)
+    index arrays in one native pass. `cross` is a uint8 owner-is-remote
+    mask or None (single process)."""
+    n = len(keys)
+    keys = np.ascontiguousarray(keys, np.int64)
+    shards = np.ascontiguousarray(shards, np.int32)
+    cross = np.zeros(n, np.uint8) if cross is None \
+        else np.ascontiguousarray(cross, np.uint8)
+    keep_l = np.empty(n, np.int64)
+    keep_x = np.empty(n, np.int64)
+    drop_l = np.empty(n, np.int64)
+    drop_x = np.empty(n, np.int64)
+    counts = np.zeros(4, np.int64)
+    lib.adapm_replica_scan2(
+        keys, shards, n, np.ascontiguousarray(intent_end.ravel(), np.int32),
+        min_clock, num_keys, cross, keep_l, keep_x, drop_l, drop_x, counts)
+    return (keep_l[: counts[0]], keep_x[: counts[1]],
+            drop_l[: counts[2]], drop_x[: counts[3]])

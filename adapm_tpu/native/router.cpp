@@ -88,7 +88,8 @@ int64_t adapm_intent_max(const int64_t* keys, int64_t n, int64_t num_keys,
   return bad;
 }
 
-// Replica expiry scan (SyncManager.sync_channel's keep/drop partition):
+// Replica expiry scan (legacy single-mask variant; superseded by
+// adapm_replica_scan2 on the planner hot path but kept for tooling):
 // for replica i at (key[i], shard[i]), keep iff
 // intent_end[shard[i]*num_keys + key[i]] >= min_clock[shard[i]].
 // Writes 1/0 into keep; returns number kept.
@@ -105,6 +106,34 @@ int64_t adapm_replica_scan(const int64_t* keys, const int32_t* shards,
     kept += k ? 1 : 0;
   }
   return kept;
+}
+
+// Partitioned replica scan (SyncManager.sync_channel): one pass over a
+// channel's (key, shard) snapshot emitting the four index partitions
+// (keep/drop x local/cross) directly, instead of a keep-mask that
+// Python re-walks. `cross` is the caller's owner-is-remote mask
+// (snapshotted under the server lock; all-zero in a single process).
+// Row indices land in the four caller-sized-n buffers; counts[4] =
+// {keep_local, keep_cross, drop_local, drop_cross}.
+void adapm_replica_scan2(const int64_t* keys, const int32_t* shards,
+                         int64_t n, const int32_t* intent_end,
+                         const int64_t* min_clock, int64_t num_keys,
+                         const uint8_t* cross,
+                         int64_t* keep_local, int64_t* keep_cross,
+                         int64_t* drop_local, int64_t* drop_cross,
+                         int64_t* counts) {
+  int64_t nkl = 0, nkx = 0, ndl = 0, ndx = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    const bool keep =
+        intent_end[(int64_t)shards[i] * num_keys + keys[i]] >=
+        min_clock[shards[i]];
+    if (keep) {
+      if (cross[i]) keep_cross[nkx++] = i; else keep_local[nkl++] = i;
+    } else {
+      if (cross[i]) drop_cross[ndx++] = i; else drop_local[ndl++] = i;
+    }
+  }
+  counts[0] = nkl; counts[1] = nkx; counts[2] = ndl; counts[3] = ndx;
 }
 
 }  // extern "C"

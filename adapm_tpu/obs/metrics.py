@@ -298,6 +298,32 @@ class CounterGroup:
         return {k: c.snap() for k, c in self._counters.items()}
 
 
+def hist_percentile(snap: Dict, q: float) -> float:
+    """Approximate quantile `q` (0..1) from a `Histogram.snap()` dict by
+    linear interpolation inside the containing bucket — the consumer-side
+    P50/P90 extraction for bounded-bucket histograms (bench.py `mgmt`
+    phase, staleness reporting). Observations in the +inf overflow bucket
+    clamp to the last finite bound; an empty histogram returns 0."""
+    count = snap.get("count", 0)
+    if not count:
+        return 0.0
+    bounds = snap["bounds"]
+    buckets = snap["buckets"]
+    target = q * count
+    acc = 0.0
+    for i, b in enumerate(buckets):
+        below = acc
+        acc += b
+        if acc >= target:
+            if i >= len(bounds):
+                return float(bounds[-1])
+            lo = float(bounds[i - 1]) if i > 0 else 0.0
+            hi = float(bounds[i])
+            frac = (target - below) / b if b else 0.0
+            return lo + frac * (hi - lo)
+    return float(bounds[-1])
+
+
 # -- global hook (call sites with no Server handle) --------------------------
 
 _global_ref: Optional["weakref.ref"] = None

@@ -103,6 +103,23 @@ def build_routes(server, keys: np.ndarray, shard: int,
                   n_remote)
 
 
+def _mark_fused_writes(server, shard: int, role_class, role_keys,
+                       skip_roles=()) -> None:
+    """Dirty-delta write tracking for a fused step's host-known roles
+    (caller holds the server lock): resolve each role's keys through the
+    addressbook — the same tables the step's routes come from, so the
+    marking is exact — and record the scatter in the stores' write
+    epochs (ShardedStore.mark_routed_writes). `skip_roles`: frozen roles
+    whose rows the step never updates."""
+    ab = server.ab
+    for r, keys in role_keys.items():
+        if r in skip_roles:
+            continue
+        k = np.asarray(keys, dtype=np.int64).ravel()
+        server.stores[role_class[r]].mark_routed_writes(
+            shard, ab.cache_slot[shard, k], ab.owner[k], ab.slot[k])
+
+
 def _read_rows(main, cache, delta, route):
     g_sh, g_sl, c_sh, c_sl, use_c = route
     m = main.at[g_sh, g_sl].get(mode="fill", fill_value=0)
@@ -451,8 +468,10 @@ class DeviceRoutedRunner:
         self.server = server
         self.shard = shard
         self.role_class = role_class
+        self.frozen_roles = frozenset(frozen_roles)
         self.router = DeviceRouter(server, shard)
         self.neg_role = neg_role
+        self._li_fallback = False  # set by _local_neg_index
         self._neg_shape = neg_shape
         self._rng = jax.random.PRNGKey(seed)
         self._alias = None
@@ -530,20 +549,40 @@ class DeviceRoutedRunner:
     def _note_step_writes(self, role_keys) -> None:
         """The fused step is a batched Push in PM terms: staged pull
         buffers covering trained keys must be invalidated like any other
-        write (caller holds the server lock). Device-drawn negatives are
-        not enumerable on the host, so runners with an in-program
-        sampler conservatively invalidate every staged batch — free in
-        practice: fused-loop workers do not Pull, so under the default
-        'auto' gating nothing is staged for them."""
-        pre = self.server.prefetch
+        write (caller holds the server lock), and the stores' dirty-delta
+        tracking must see the step's scatter (core/store.py) or the sync
+        planner would skip shipping the trained replicas. Device-drawn
+        negatives are not enumerable on the host, so runners with an
+        in-program sampler conservatively invalidate every staged batch
+        and mark the negative class's whole shard written."""
+        srv = self.server
+        _mark_fused_writes(srv, self.shard, self.role_class, role_keys,
+                           skip_roles=self.frozen_roles)
+        pre = srv.prefetch
         if pre is None or not pre._staged:
             return
         if self.neg_role is not None:
             pre.invalidate_all()
             return
-        self.server._prefetch_note(np.concatenate(
+        srv._prefetch_note(np.concatenate(
             [np.asarray(k, dtype=np.int64).ravel()
              for k in role_keys.values()]))
+
+    def _mark_neg_writes(self) -> None:
+        """Write tracking for device-drawn negatives (caller holds the
+        server lock, AFTER _local_neg_index refreshed for this step):
+        their rows are not enumerable on the host, so the negative
+        class's whole shard counts as written — every shard when the
+        local-index fallback is live, because a full-population draw
+        scatters into other shards' main rows too."""
+        if self.neg_role is None:
+            return
+        st = self.server.stores[self.role_class[self.neg_role]]
+        if self._li_fallback:
+            for s in range(self.server.num_shards):
+                st.mark_shard_written(s)
+        else:
+            st.mark_shard_written(self.shard)
 
     def prefetch_keys(self, role_keys: Dict[str, np.ndarray]) -> StagedKeys:
         """Pre-stage a future step's key batch on device (the staging
@@ -631,6 +670,10 @@ class DeviceRoutedRunner:
         local = (ab.owner[pop] == self.shard) | (
             ab.cache_slot[self.shard, pop] != NO_SLOT)
         idx = pop[local]
+        # fallback flag feeds _mark_neg_writes: full-population draws can
+        # scatter into OTHER shards' main rows, so write tracking must
+        # widen beyond this shard
+        self._li_fallback = len(idx) == 0
         if len(idx) == 0:
             idx = pop  # nothing local: draw from the full population
         cap = bucket_size(len(idx), minimum=64)
@@ -679,6 +722,7 @@ class DeviceRoutedRunner:
             tables = self.router.tables()
             local_index = self._local_neg_index() \
                 if self.neg_role is not None else None
+            self._mark_neg_writes()
             sub = self._next_rng()
             # keys validated above to be inside [0, num_keys)
             kdtype = _key_dtype(srv.num_keys)
@@ -732,6 +776,7 @@ class DeviceRoutedRunner:
             tables = self.router.tables()
             local_index = self._local_neg_index() \
                 if self.neg_role is not None else None
+            self._mark_neg_writes()
             # draw through _next_rng so the key sequence is IDENTICAL to K
             # sequential __call__ steps (refills included) — the scan-vs-
             # sequential equivalence depends on it when negatives are
@@ -774,6 +819,7 @@ class FusedStepRunner:
                  role_dim: Dict[str, int], frozen_roles: Sequence[str] = ()):
         self.server = server
         self.role_class = role_class
+        self.frozen_roles = frozenset(frozen_roles)
         self.step_fn = make_fused_adagrad_step(
             loss_fn, role_class, role_dim, frozen_roles)
         self.n_remote = 0
@@ -801,6 +847,11 @@ class FusedStepRunner:
                     [np.asarray(k, dtype=np.int64).ravel()
                      for k in role_keys.values()]))
             routes = self.routes_for(role_keys, shard)
+            # mark the stores' dirty-delta tracking AFTER routes_for:
+            # its ensure_local may localize keys, and the marking must
+            # see the placement the step scatters into
+            _mark_fused_writes(srv, shard, self.role_class, role_keys,
+                               skip_roles=self.frozen_roles)
             pools = tuple((s.main, s.cache, s.delta) for s in srv.stores)
             pools, loss = self.step_fn(
                 pools, routes, aux, jnp.float32(lr), jnp.float32(eps))

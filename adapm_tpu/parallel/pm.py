@@ -651,7 +651,6 @@ class GlobalPM:
         as main copies on `shard`."""
         srv = self.server
         from ..core.store import OOB
-        from ..core.sync import key_channel
         lens = srv.value_lengths[keys]
         offs = _offsets(lens)
         with srv._lock, srv._topology_mutation():
@@ -673,9 +672,7 @@ class GlobalPM:
                         cs[has].astype(np.int32))
                     rows[has] += d
                     dropped = ks[has]
-                    chans = key_channel(dropped, srv.sync.num_channels)
-                    for k, c in zip(dropped.tolist(), chans.tolist()):
-                        srv.sync.replicas[c].discard((int(k), s))
+                    srv.sync.replica_discard(dropped, s)
                     ab.drop_replicas(dropped, s)
                 shards, slots = ab.adopt_batch(ks, shard)
                 nk = len(ks)
@@ -683,7 +680,7 @@ class GlobalPM:
                     shards.astype(np.int32), slots.astype(np.int32),
                     rows, np.zeros(nk, np.int32), np.full(nk, OOB, np.int32))
             self.stats["relocations_in"] += len(keys)
-            srv.sync.stats.relocations += len(keys)
+            srv.sync.stats.add(relocations=len(keys))
             if srv.tracer is not None:
                 from ..utils.stats import RELOCATE
                 srv.tracer.record(keys, RELOCATE, shard)
@@ -693,7 +690,6 @@ class GlobalPM:
         """Install replicas of remote-owned keys on local `shard` with the
         owner-provided base values."""
         srv = self.server
-        from ..core.sync import key_channel
         lens = srv.value_lengths[keys]
         offs = _offsets(lens)
         surplus: List[np.ndarray] = []
@@ -735,10 +731,8 @@ class GlobalPM:
                         srv.stores[cid].install_replica_rows(
                             np.full(len(took), shard, np.int32),
                             cs.astype(np.int32), rows)
-                        chans = key_channel(took, srv.sync.num_channels)
-                        for k, c in zip(took.tolist(), chans.tolist()):
-                            srv.sync.replicas[c].add((int(k), shard))
-                        srv.sync.stats.replicas_created += len(took)
+                        srv.sync.replica_add(took, shard)
+                        srv.sync.stats.add(replicas_created=len(took))
                         if srv.tracer is not None:
                             from ..utils.stats import REPLICA_SETUP
                             srv.tracer.record(took, REPLICA_SETUP, shard)
@@ -806,21 +800,21 @@ class GlobalPM:
         self._drive(keys, make, self._serve_sync, merge, "sync")
         return fresh
 
-    def sync_replicas(self, items: List[Tuple[int, int]]) -> None:
-        """One cross-process sync round over local replicas of remote keys:
-        extract pending deltas, ship to owners, install fresh bases.
-        Requester side of the reference's startSync/response branch
-        (sync_manager.h:291-382, 740-799)."""
-        with self.delta_window_for(
-                np.fromiter((k for k, _ in items), np.int64, len(items))):
-            self._sync_replicas_locked(items)
+    def sync_replicas(self, keys: np.ndarray, shards: np.ndarray) -> None:
+        """One cross-process sync round over local replicas of remote keys
+        (parallel key / holder-shard arrays): extract pending deltas,
+        ship to owners, install fresh bases. Requester side of the
+        reference's startSync/response branch (sync_manager.h:291-382,
+        740-799)."""
+        with self.delta_window_for(np.asarray(keys, np.int64)):
+            self._sync_replicas_locked(keys, shards)
 
-    def _extract_deltas(self, items: List[Tuple[int, int]]):
-        """Snapshot live replica items + their pending delta rows; returns
+    def _extract_deltas(self, keys: np.ndarray, shards: np.ndarray):
+        """Snapshot live replica pairs + their pending delta rows; returns
         None when nothing is live, else the state _install_fresh needs."""
         srv = self.server
-        karr = np.fromiter((k for k, _ in items), np.int64, len(items))
-        sarr = np.fromiter((s for _, s in items), np.int32, len(items))
+        karr = np.ascontiguousarray(keys, dtype=np.int64)
+        sarr = np.ascontiguousarray(shards, dtype=np.int32)
         class_rows: Dict[int, tuple] = {}
         with srv._lock:
             # skip replicas dropped/upgraded since the caller's snapshot
@@ -864,8 +858,9 @@ class GlobalPM:
                                  pos[live]).reshape(-1, L),
                     rows[live])
 
-    def _sync_replicas_locked(self, items: List[Tuple[int, int]]) -> None:
-        ext = self._extract_deltas(items)
+    def _sync_replicas_locked(self, keys: np.ndarray,
+                              shards: np.ndarray) -> None:
+        ext = self._extract_deltas(keys, shards)
         if ext is None:
             return
         karr, sarr, cs_all, class_rows, lens, offs, shipped = ext
@@ -873,21 +868,21 @@ class GlobalPM:
         self._install_fresh(karr, sarr, cs_all, class_rows, lens, offs,
                             fresh)
         with self._stats_lock:
-            self.stats["keys_synced_out"] += len(items)
+            self.stats["keys_synced_out"] += len(keys)
 
-    def collective_sync(self, items: List[Tuple[int, int]],
+    def collective_sync(self, keys: np.ndarray, shards: np.ndarray,
                         quiescing: bool = True) -> bool:
         """BSP replica refresh over device collectives
         (parallel/collective.py): same contract as sync_replicas, but
         EVERY process must call this together (the WaitSync/quiesce
-        protocol, or a --sys.collective_cadence clock boundary) — `items`
+        protocol, or a --sys.collective_cadence clock boundary) — `keys`
         may be empty and the process still joins each exchange. Enabled
         by --sys.collective_sync. Returns True iff every process entered
         this exchange with quiescing=True (the cadence flag loop's
         termination test, core/sync.py)."""
         assert self.coll is not None, "--sys.collective_sync is off"
         with self.delta_window():
-            ext = self._extract_deltas(items)
+            ext = self._extract_deltas(keys, shards)
             if ext is None:
                 empty = np.empty(0, dtype=np.int64)
                 _, all_q = self.coll.request_sync(
@@ -959,20 +954,21 @@ class GlobalPM:
         with self.server.sync._coll_lock:  # see collective_pull
             self.coll.request_sync(keys, flat, lens, quiescing=False)
 
-    def drop_replicas(self, items: List[Tuple[int, int]]) -> None:
-        """Drop local replicas of remote-owned keys: ship the final delta
-        with the unsubscription, then free the slots. Any pushes that land
+    def drop_replicas(self, keys: np.ndarray, shards: np.ndarray) -> None:
+        """Drop local replicas of remote-owned keys (parallel key /
+        holder-shard arrays): ship the final delta with the
+        unsubscription, then free the slots. Any pushes that land
         between extraction and the free are re-shipped as plain remote
         pushes, so no update is ever lost."""
-        with self.delta_window_for(
-                np.fromiter((k for k, _ in items), np.int64, len(items))):
-            self._drop_replicas_locked(items)
+        with self.delta_window_for(np.asarray(keys, np.int64)):
+            self._drop_replicas_locked(keys, shards)
 
-    def _drop_replicas_locked(self, items: List[Tuple[int, int]]) -> None:
+    def _drop_replicas_locked(self, keys: np.ndarray,
+                              shards: np.ndarray) -> None:
         srv = self.server
-        from ..core.sync import key_channel
-        karr = np.fromiter((k for k, _ in items), np.int64, len(items))
-        sarr = np.fromiter((s for _, s in items), np.int32, len(items))
+        karr = np.ascontiguousarray(keys, dtype=np.int64)
+        sarr = np.ascontiguousarray(shards, dtype=np.int32)
+        req_k, req_s = karr, sarr  # the full request (channel discard)
         class_rows: Dict[int, tuple] = {}
         with srv._lock:
             ok = srv.ab.cache_slot[sarr, karr] >= 0
@@ -1018,10 +1014,7 @@ class GlobalPM:
                         from ..utils.stats import REPLICA_DROP
                         srv.tracer.record(karr[pos][m], REPLICA_DROP,
                                           int(s))
-            for k, s in items:
-                c = int(key_channel(np.asarray([k]),
-                                    srv.sync.num_channels)[0])
-                srv.sync.replicas[c].discard((int(k), int(s)))
+            srv.sync.replica_discard(req_k, req_s)
             if not dropped_any:
                 tm.cancel()  # every replica was already dropped/upgraded
         if residue_keys:

@@ -183,15 +183,17 @@ def restore_server(server, path: str) -> None:
                 ab.cache_slot[s, class_keys] for s in range(server.num_shards)]
             _rebuild_cache_alloc(ab.cache_alloc[cid], used_by_shard)
 
-        # rebuild the sync manager's replica registry
-        from ..core.sync import key_channel
-        for reps in server.sync.replicas:
-            reps.clear()
+        # rebuild the sync manager's replica registry (one vectorized
+        # channel-grouped insert, never per key), and reset the stores'
+        # write-epoch tracking: the restored pools' replica bases may
+        # predate their main rows, so everything starts dirty and the
+        # first sync round re-ships every live replica once
+        server.sync.replica_clear()
         shards, keys = np.nonzero(ab.cache_slot >= 0)
-        chans = key_channel(keys.astype(np.int64),
-                            server.sync.num_channels)
-        for k, s, c in zip(keys, shards, chans):
-            server.sync.replicas[int(c)].add((int(k), int(s)))
+        server.sync.replica_add(keys.astype(np.int64),
+                                shards.astype(np.int32))
+        for st in server.stores:
+            st.reset_write_tracking()
         if server.glob is not None:
             server.glob.owner_hint[:] = ck["owner_hint"]
             server.glob.reloc[:] = ck["reloc"]

@@ -284,6 +284,79 @@ def bench_adaptive_pm(E=20_000, d=32, B=1024, N=8, steps=30):
     return out
 
 
+def bench_mgmt(replicas=50_000, vlen=16, rounds=40, trickle=512):
+    """Management-plane microbench (ISSUE 3): planner rounds/sec and
+    replica-staleness P50/P90 at ~`replicas` live replicas on a CPU
+    mesh. One worker holds never-expiring intent on keys owned by other
+    shards (REPLICATION_ONLY pins the decision); between rounds a
+    `trickle`-key push batch lands (~1% of the table — the realistic
+    shape the dirty filter exists for: most replicas idle, a small hot
+    set written), and ONLY the `run_round` calls are timed, so the
+    number is the planner's cost, not the workload generator's.
+    docs/PERF.md "Management-plane scaling" records before/after
+    numbers for this host."""
+    import jax
+
+    from adapm_tpu import Server
+    from adapm_tpu.base import CLOCK_MAX, MgmtTechniques
+    from adapm_tpu.config import SystemOptions
+    from adapm_tpu.obs.metrics import hist_percentile
+    from adapm_tpu.parallel.mesh import Mesh, MeshContext
+
+    cpu = jax.devices("cpu")
+    mesh = MeshContext(Mesh(np.asarray(cpu), ("kv",)))
+    S = mesh.num_shards
+    assert S >= 2, "mgmt phase needs >= 2 virtual shards"
+    num_keys = int(replicas * S / (S - 1)) + 512
+    srv = Server(num_keys, vlen, ctx=mesh,
+                 opts=SystemOptions(
+                     techniques=MgmtTechniques.REPLICATION_ONLY,
+                     sync_max_per_sec=0, prefetch=False,
+                     cache_slots_per_shard=replicas + 1024))
+    w = srv.make_worker(1)
+    keys = np.arange(num_keys)
+    cand = keys[srv.ab.owner[keys] != w.shard][:replicas]
+    _progress(f"mgmt phase: replicating {replicas} keys onto shard "
+              f"{w.shard} ({S} shards)")
+    w.intent(cand, 0, CLOCK_MAX)
+    srv.sync.run_round(force_intents=True, all_channels=True)
+    live = int(sum(len(t) for t in srv.sync.replicas))
+    rng = np.random.default_rng(0)
+
+    def trickle_push():
+        hot = rng.choice(cand, trickle, replace=False)
+        w.push(hot, np.ones((trickle, vlen), np.float32))
+
+    # warmup compiles every channel's sync-program bucket shape
+    for _ in range(2 * srv.sync.num_channels):
+        trickle_push()
+        srv.sync.run_round()
+        w.advance_clock()
+    srv.block()
+    _progress("mgmt phase: timing")
+    dt = 0.0
+    for _ in range(rounds):
+        trickle_push()
+        t0 = time.perf_counter()
+        srv.sync.run_round()
+        dt += time.perf_counter() - t0
+        w.advance_clock()
+    srv.block()
+    stale = srv.sync._h_staleness.snap()
+    st = srv.sync.stats
+    out = {"replicas_live": live,
+           "rounds_per_sec": round(rounds / dt, 2),
+           "round_ms": round(dt / rounds * 1e3, 2),
+           "staleness_p50_clocks": round(hist_percentile(stale, 0.50), 2),
+           "staleness_p90_clocks": round(hist_percentile(stale, 0.90), 2),
+           "keys_shipped": st.keys_synced,
+           "keys_considered": st.keys_considered,
+           "dirty_filter": bool(srv.opts.sync_dirty_only),
+           "trickle_keys_per_round": trickle}
+    srv.shutdown()
+    return out
+
+
 def bench_w2v(V=100_000, d=128, B=8192, N=5, steps=40, warmup=4,
               scan_steps=1) -> float:
     """word2vec SGNS fused-step throughput (pairs/sec) with on-device
@@ -499,6 +572,17 @@ def _phase_pm():
     return out
 
 
+def _phase_mgmt():
+    import jax
+    sz = {"replicas": 20_000, "rounds": 24, "trickle": 256} \
+        if os.environ.get("ADAPM_BENCH_SMALL") else {}
+    out = bench_mgmt(**sz)
+    out["virtual_shards"] = len(jax.devices("cpu"))
+    if sz:
+        out["small_sizes"] = sz
+    return out
+
+
 def _phase_w2v():
     if os.environ.get("ADAPM_BENCH_SMALL"):
         small = dict(V=20_000, d=64, B=2048, warmup=2)
@@ -527,13 +611,13 @@ def _phase_cpu():
 
 _PHASES = {"probe": _phase_probe, "kge": _phase_kge,
            "prefetch": _phase_prefetch, "scan": _phase_scan,
-           "dedup": _phase_dedup, "pm": _phase_pm, "w2v": _phase_w2v,
-           "cpu": _phase_cpu}
+           "dedup": _phase_dedup, "pm": _phase_pm, "mgmt": _phase_mgmt,
+           "w2v": _phase_w2v, "cpu": _phase_cpu}
 
 # generous per-phase walls: a healthy phase finishes in a fraction of
 # these; a wedged relay burns one wall once, then the driver degrades
 _TIMEOUTS = {"probe": 120, "kge": 1200, "prefetch": 1200, "scan": 900,
-             "dedup": 900, "pm": 900, "w2v": 900, "cpu": 600}
+             "dedup": 900, "pm": 900, "mgmt": 900, "w2v": 900, "cpu": 600}
 
 _CPU_ENV = {"JAX_PLATFORMS": "cpu", "ADAPM_PLATFORM": "cpu",
             "ADAPM_BENCH_SMALL": "1"}
@@ -634,6 +718,12 @@ def main():
     pm_shards = 8 if cores >= 4 else 2
     pm_env["XLA_FLAGS"] = mesh_flags(pm_shards)
     results["pm"] = _run_phase("pm", pm_env)
+    # management-plane microbench (ISSUE 3): same host-CPU mesh sizing
+    # as pm, full-size replica population even on small hosts (the
+    # phase measures the host-side planner, not device compute)
+    mgmt_env = dict(pm_env)
+    mgmt_env.pop("ADAPM_BENCH_SMALL", None)
+    results["mgmt"] = _run_phase("mgmt", mgmt_env)
     results["cpu"] = _run_phase("cpu")
 
     def phase_val(name, field):
@@ -694,6 +784,8 @@ def main():
         "scan_gain": (round(tput_scan / tput - 1.0, 3)
                       if scan_comparable else None),
         "pm": pm,
+        "mgmt": (results["mgmt"] if _ok(results["mgmt"])
+                 else {"error": "mgmt failed"}),
         "w2v_pairs_per_sec": round(w2v, 1),
         "dedup": {"unique_batch_triples_per_sec": round(tput_unique, 1),
                   "gain_vs_skewed":

@@ -16,5 +16,9 @@ python -m pytest tests/test_prefetch.py -q
 # registry must stay under its hot-path budget and no two subsystems may
 # register the same metric (docs/OBSERVABILITY.md)
 python scripts/metrics_overhead_check.py
+# management-plane ratio guard (ISSUE 3): the vectorized planner round
+# must stay a small fraction of the per-key-Python shadow cost —
+# reintroduced set/fromiter/listcomp hot loops cost a multiple
+python scripts/mgmt_plane_check.py
 python -m pytest tests/ -q "$@"
 echo "ALL TESTS PASSED"

@@ -99,6 +99,32 @@ def test_replica_scan(lib):
     assert kept == 1 and keep.tolist() == [1, 0]
 
 
+def test_replica_scan_partition(lib):
+    """adapm_replica_scan2 emits the four keep/drop x local/cross index
+    partitions in one pass (and agrees with the legacy keep mask)."""
+    from adapm_tpu.native import replica_scan_partition
+    num_keys = 16
+    ie = np.full((2, num_keys), -1, dtype=np.int32)
+    ie[0, 3] = 100   # keep (local)
+    ie[1, 4] = 1     # drop (cross)
+    ie[0, 7] = 100   # keep (cross)
+    min_clock = np.array([50, 50], dtype=np.int64)
+    keys = np.array([3, 4, 7, 9], dtype=np.int64)
+    shards = np.array([0, 1, 0, 1], dtype=np.int32)
+    cross = np.array([0, 1, 1, 0], dtype=np.uint8)
+    kl, kx, dl, dx = replica_scan_partition(
+        lib, keys, shards, ie, min_clock, num_keys, cross)
+    assert kl.tolist() == [0]
+    assert kx.tolist() == [2]
+    assert dl.tolist() == [3]
+    assert dx.tolist() == [1]
+    # single-process shape: cross=None -> everything is local
+    kl, kx, dl, dx = replica_scan_partition(
+        lib, keys, shards, ie, min_clock, num_keys, None)
+    assert kl.tolist() == [0, 2] and len(kx) == 0
+    assert dl.tolist() == [1, 3] and len(dx) == 0
+
+
 def test_server_uses_native(lib):
     """End-to-end: a Server built in this environment routes via the
     native library and produces correct pull/push results."""

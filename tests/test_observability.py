@@ -197,10 +197,18 @@ def test_metrics_snapshot_schema_stable():
     srv, w = _run_instrumented(SystemOptions(sync_max_per_sec=0,
                                              prefetch_pull="always"))
     snap = srv.metrics_snapshot()
-    # the documented schema contract (docs/OBSERVABILITY.md)
-    assert snap["schema_version"] == 1 and snap["metrics_enabled"]
+    # the documented schema contract (docs/OBSERVABILITY.md); v2 = the
+    # PR 3 sync-section changes (keys_shipped/keys_considered semantics,
+    # replicas_live/dirty_fraction gauges)
+    assert snap["schema_version"] == 2 and snap["metrics_enabled"]
     for sec in srv._SNAPSHOT_SECTIONS:
         assert isinstance(snap[sec], dict), sec
+    # v2 sync surface: shipped vs considered + table-occupancy gauges
+    assert snap["sync"]["keys_shipped"] == snap["sync"]["keys_synced"]
+    assert snap["sync"]["keys_considered"] >= snap["sync"]["keys_synced"]
+    assert snap["sync"]["replicas_live"] >= 0
+    assert 0.0 <= snap["sync"]["dirty_fraction"] <= 1.0
+    assert "replicas_live.c0" in snap["sync"]
     # kv: latency histograms + op counters + the ts=-1 rate
     assert snap["kv"]["pull_s"]["count"] >= 2
     assert snap["kv"]["push_s"]["count"] >= 1

@@ -135,12 +135,60 @@ class SystemOptions:
     # intermittent XLA-CPU hard aborts (CHANGES.md r6). Default on.
     crash_dumps: bool = True
 
+    # -- online serving plane (sys.serve.*; adapm_tpu/serve,
+    #    docs/SERVING.md). Knob ranges are validated by validate_serve()
+    #    at parse time AND at ServePlane construction — bad combinations
+    #    fail loudly instead of mis-serving.
+    # requests coalesced into one fused lookup gather (>= 1)
+    serve_max_batch: int = 64
+    # micro-batch window: how long the dispatcher lingers after the
+    # first request to coalesce more (>= 0; 0 = dispatch immediately
+    # with whatever is already queued)
+    serve_max_wait_us: int = 200
+    # admission queue bound (> 0): submissions beyond this are rejected
+    # with ServeOverloadError (backpressure, never an unbounded queue)
+    serve_queue: int = 1024
+    # default per-lookup deadline in ms (0 = none); expired requests
+    # are shed loudly (DeadlineExceededError), never parked
+    serve_deadline_ms: float = 0.0
+
     # -- sampling (--sampling.*)
     sampling_scheme: str = "local"   # naive | preloc | pool | local
     sampling_reuse_factor: int = 32  # pool scheme
     sampling_pool_size: int = 0      # pool scheme; 0 = auto
     sampling_batch_size: int = 1024  # RNG batching
     sampling_with_replacement: bool = True
+
+    def validate_serve(self) -> None:
+        """Range/consistency checks for the --sys.serve.* surface
+        (ISSUE 4 satellite). Raises ValueError; called by `from_args`
+        (parse-time) and by `ServePlane.__init__` (hand-built options),
+        so a bad knob fails loudly before it can mis-serve."""
+        if self.serve_max_batch < 1:
+            raise ValueError(
+                f"--sys.serve.max_batch must be >= 1 "
+                f"(got {self.serve_max_batch}): a coalescer that can "
+                f"never form a batch serves nothing")
+        if self.serve_max_wait_us < 0:
+            raise ValueError(
+                f"--sys.serve.max_wait_us must be >= 0 "
+                f"(got {self.serve_max_wait_us})")
+        if self.serve_queue < 1:
+            raise ValueError(
+                f"--sys.serve.queue must be > 0 (got {self.serve_queue}): "
+                f"a zero-bound admission queue rejects every request")
+        if self.serve_deadline_ms < 0:
+            raise ValueError(
+                f"--sys.serve.deadline_ms must be >= 0 "
+                f"(got {self.serve_deadline_ms}; 0 = no deadline)")
+        if self.serve_queue < self.serve_max_batch:
+            raise ValueError(
+                f"inconsistent serve knobs: --sys.serve.queue "
+                f"({self.serve_queue}) < --sys.serve.max_batch "
+                f"({self.serve_max_batch}) — the admission queue could "
+                f"never hold a full micro-batch, so the configured batch "
+                f"size is unreachable; raise the queue bound or lower "
+                f"max_batch")
 
     @staticmethod
     def add_arguments(parser: argparse.ArgumentParser) -> None:
@@ -204,6 +252,15 @@ class SystemOptions:
                        dest="sys_trace_spans_out", default=None)
         g.add_argument("--sys.crash_dumps", dest="sys_crash_dumps",
                        type=int, default=1)
+        g.add_argument("--sys.serve.max_batch", dest="sys_serve_max_batch",
+                       type=int, default=64)
+        g.add_argument("--sys.serve.max_wait_us",
+                       dest="sys_serve_max_wait_us", type=int, default=200)
+        g.add_argument("--sys.serve.queue", dest="sys_serve_queue",
+                       type=int, default=1024)
+        g.add_argument("--sys.serve.deadline_ms",
+                       dest="sys_serve_deadline_ms", type=float,
+                       default=0.0)
         s = parser.add_argument_group("sampling")
         s.add_argument("--sampling.scheme", dest="sampling_scheme",
                        default="local",
@@ -221,7 +278,7 @@ class SystemOptions:
 
     @classmethod
     def from_args(cls, args: argparse.Namespace) -> "SystemOptions":
-        return cls(
+        opts = cls(
             techniques=MgmtTechniques(args.sys_techniques),
             channels=args.sys_channels,
             location_caches=bool(args.sys_location_caches),
@@ -251,9 +308,15 @@ class SystemOptions:
             trace_spans=bool(args.sys_trace_spans),
             trace_spans_out=args.sys_trace_spans_out,
             crash_dumps=bool(args.sys_crash_dumps),
+            serve_max_batch=args.sys_serve_max_batch,
+            serve_max_wait_us=args.sys_serve_max_wait_us,
+            serve_queue=args.sys_serve_queue,
+            serve_deadline_ms=args.sys_serve_deadline_ms,
             sampling_scheme=args.sampling_scheme,
             sampling_reuse_factor=args.sampling_reuse,
             sampling_pool_size=args.sampling_pool_size,
             sampling_batch_size=args.sampling_batch_size,
             sampling_with_replacement=not args.sampling_without_replacement,
         )
+        opts.validate_serve()  # parse-time rejection of bad serve knobs
+        return opts

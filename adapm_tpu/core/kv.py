@@ -240,6 +240,10 @@ class Server:
                 control.start_heartbeat(self.opts.heartbeat_s)
 
         self.sampling = None  # set by enable_sampling_support
+        # online serving plane (adapm_tpu/serve): attached by
+        # ServePlane.__init__ so metrics_snapshot can fold readiness in
+        # and shutdown can close it; None until a plane is built
+        self._serve_plane = None
 
         # native host-routing core (C++ via ctypes; None -> numpy fallback)
         from ..native import get_lib
@@ -1156,6 +1160,10 @@ class Server:
                 self.sync.run_round()
 
     def shutdown(self) -> None:
+        if self._serve_plane is not None:
+            # stop admitting lookups first: the serve dispatcher reads
+            # through the same pools the teardown below blocks on
+            self._serve_plane.close()
         if self._reporter is not None:
             self._reporter.stop()
             self._reporter = None
@@ -1242,7 +1250,8 @@ class Server:
     # snapshot sections guaranteed present (possibly empty) in every
     # metrics_snapshot() — the schema-stability contract tests pin
     _SNAPSHOT_SECTIONS = ("kv", "prefetch", "plan_cache", "staging",
-                          "sync", "pm", "collective", "fused", "spans")
+                          "sync", "pm", "collective", "fused", "spans",
+                          "serve")
 
     def metrics_snapshot(self, drain_device: bool = True) -> Dict:
         """One structured, JSON-serializable telemetry dict for this
@@ -1262,13 +1271,27 @@ class Server:
         keys (post-dirty-filter; `sync.keys_shipped` is an alias), the
         new `sync.keys_considered` counts examined replicas, and the
         sync section gains `replicas_live`/`dirty_fraction` gauges
-        (total + per channel)."""
-        out: Dict = {"schema_version": 2,
+        (total + per channel).
+
+        schema_version 3 (PR 4): new `serve` section — the online
+        serving plane's qps/latency/queue/shed metrics plus the
+        liveness/readiness surface (`serve.ready`, `serve.dead_peers`,
+        and the embedded `readiness` detail dict when a ServePlane is
+        attached); `{}` when no plane was ever built."""
+        out: Dict = {"schema_version": 3,
                      "metrics_enabled": bool(self.obs.enabled)}
         for s in self._SNAPSHOT_SECTIONS:
             out[s] = {}
         if not self.obs.enabled:
             return out
+        serve_ready = None
+        if self._serve_plane is not None:
+            # probe readiness ONCE, BEFORE the registry snapshot: the
+            # serve.ready/dead_peers gauges then read this result's
+            # cache instead of each paying their own dead-peer probe
+            # (multi-process, a probe is one coordinator KV read per
+            # peer), and the gauges agree with the embedded dict below
+            serve_ready = self._serve_plane.health.readiness()
         for sec, vals in self.obs.snapshot().items():
             out.setdefault(sec, {}).update(vals)
         # kv: worker-aggregated op/param counters + the ts=-1 rate
@@ -1300,6 +1323,11 @@ class Server:
                      for k, v in self.glob.coll.stats.items()})
         if self.spans is not None:
             out["spans"].update(self.spans.stats())
+        if serve_ready is not None:
+            # readiness detail rides with the serve.* gauges: dead peers
+            # (Server.dead_nodes — detection-only), queue depth/bound,
+            # and the human-readable not-ready reasons
+            out["serve"]["readiness"] = serve_ready
         return out
 
     def write_trace(self) -> Optional[str]:

@@ -295,6 +295,13 @@ class ShardedStore:
         self.repl_epoch = np.zeros((S, self.cache_slots), dtype=np.int64)
         self.delta_dirty = np.zeros((S, self.cache_slots), dtype=bool)
 
+        # host-side count of dispatched gather programs. Lock-free (a
+        # racing increment may be lost): this is a LIVENESS probe — the
+        # serve idle guard (scripts/serve_latency_check.py) asserts it
+        # does not move while the serving plane is idle — not an exact
+        # accounting surface.
+        self.gathers = 0
+
     def _next_epoch(self) -> int:
         self._epoch += 1
         return self._epoch
@@ -350,6 +357,7 @@ class ShardedStore:
 
     def gather(self, o_shard, o_slot, c_shard, c_slot, use_cache):
         n = len(o_shard)
+        self.gathers += 1
         a = pad_bucket(n, (o_shard, 0), (o_slot, OOB), (c_shard, 0),
                        (c_slot, OOB), (use_cache, False),
                        minimum=self.bucket_min)

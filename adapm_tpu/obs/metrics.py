@@ -38,6 +38,17 @@ from typing import Callable, Dict, List, Optional
 # overflow bucket is implicit (len(bounds) + 1 buckets total)
 LATENCY_BOUNDS_S = tuple(1e-6 * 4 ** i for i in range(13))
 
+# serving-path latency ladder: geometric x2 from 20 µs to ~2.6 s. The
+# serve plane reports P50/P99 through `hist_percentile`, whose in-bucket
+# interpolation error is bounded by the bucket ratio — x2 halves the
+# worst-case error of the x4 default where the latency SLO lives
+# (adapm_tpu/serve; docs/SERVING.md "Tuning").
+SERVE_LATENCY_BOUNDS_S = tuple(2e-5 * 2 ** i for i in range(18))
+
+# micro-batch size ladder (requests per coalesced batch): powers of two
+# up to 1024 — `serve.batch_size` is a count histogram, not a latency
+BATCH_SIZE_BOUNDS = tuple(float(2 ** i) for i in range(11))
+
 
 class Counter:
     """Monotonic float counter, per-thread sharded."""
@@ -219,8 +230,15 @@ class MetricsRegistry:
 
     def gauge(self, name: str, unit: str = "", fn=None,
               shared: bool = False) -> Gauge:
-        return self._register(name, "gauge",
-                              lambda: Gauge(name, unit, fn=fn), shared)
+        g = self._register(name, "gauge",
+                           lambda: Gauge(name, unit, fn=fn), shared)
+        if shared and fn is not None and isinstance(g, Gauge):
+            # a shared gauge rebinds to the LATEST provider: a subsystem
+            # torn down and rebuilt on the same server (e.g. a second
+            # ServePlane after close()) must not leave the gauge reading
+            # the dead instance's structures
+            g._fn = fn
+        return g
 
     def histogram(self, name: str, unit: str = "s",
                   bounds=LATENCY_BOUNDS_S,

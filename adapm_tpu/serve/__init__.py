@@ -1,0 +1,80 @@
+"""Online serving plane (ISSUE 4 tentpole): the PM as a query-servable
+store.
+
+Training built the store; this layer reads it under load. The pieces
+(each in its own module, docs/SERVING.md has the user guide):
+
+  - `admission` — bounded request queue with backpressure + deadlines
+    (reject loudly, never hang);
+  - `batcher`  — micro-batching coalescer: concurrent lookups merge
+    into one deduplicated key batch dispatched as a single fused gather
+    per length class through the routing-plan cache;
+  - `session`  — the client API: `ServeSession.lookup(keys,
+    deadline_ms)`, snapshot-consistent and bit-identical to a plain
+    `Worker.pull`, including read-your-writes for clients that push;
+  - `health`   — liveness/readiness folding `Server.dead_nodes` and
+    queue depth into `metrics_snapshot()` (serve section, schema v3).
+
+Quickstart::
+
+    from adapm_tpu.serve import ServePlane
+    plane = ServePlane(server)            # knobs from server.opts
+    sess = plane.session()                # one per client thread
+    vals = sess.lookup(keys, deadline_ms=50)
+    plane.close()                         # or rely on server.shutdown()
+"""
+from __future__ import annotations
+
+from .admission import (AdmissionQueue, DeadlineExceededError,  # noqa: F401
+                        LookupRequest, ServeOverloadError)
+from .batcher import LookupBatcher  # noqa: F401
+from .health import HealthMonitor  # noqa: F401
+from .session import ServeSession  # noqa: F401
+
+
+class ServePlane:
+    """Assembles queue + batcher + health over one Server and owns their
+    lifecycle. One live plane per Server (the serve.* metrics namespace
+    is single-registration; a plane closed and rebuilt on the same
+    server reuses it — gauges rebind to the new plane)."""
+
+    def __init__(self, server, opts=None, shard: int = 0,
+                 start: bool = True, dead_nodes_fn=None,
+                 dead_node_max_age_s: float = 10.0):
+        opts = opts if opts is not None else server.opts
+        opts.validate_serve()  # fail loudly on bad knobs, even when the
+        # options object was hand-built rather than parsed
+        if getattr(server, "_serve_plane", None) is not None:
+            raise RuntimeError(
+                "one live ServePlane per Server: close() the existing "
+                "plane first")
+        self.server = server
+        self.opts = opts
+        self.queue = AdmissionQueue(opts.serve_queue, registry=server.obs)
+        self.batcher = LookupBatcher(server, opts, self.queue, shard=shard)
+        self.health = HealthMonitor(self, max_age_s=dead_node_max_age_s,
+                                    dead_nodes_fn=dead_nodes_fn)
+        server._serve_plane = self
+        if start:
+            self.start()
+
+    def start(self) -> None:
+        self.batcher.start()
+
+    def session(self, worker=None) -> ServeSession:
+        """A client handle (one per client thread; cheap). Pass the
+        client's `Worker` for cross-process read-your-writes ordering."""
+        return ServeSession(self, worker=worker)
+
+    def close(self) -> None:
+        """Stop the dispatcher and fail-stop queued requests. Idempotent;
+        also called by `Server.shutdown()`."""
+        self.batcher.stop()
+        if getattr(self.server, "_serve_plane", None) is self:
+            self.server._serve_plane = None
+
+    def __enter__(self) -> "ServePlane":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

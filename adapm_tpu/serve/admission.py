@@ -1,0 +1,253 @@
+"""Admission control for the online serving plane: bounded queue,
+deadlines, shed-don't-hang.
+
+The serve plane follows the fail-stop stance of docs/failure_handling.md:
+an overloaded or expired request is rejected LOUDLY — `submit` raises
+`ServeOverloadError` the instant the bounded queue is full (backpressure
+the caller can act on: retry, spill, or scale), and a request whose
+deadline passes before a micro-batch claims it is shed with
+`DeadlineExceededError`. Nothing is ever parked indefinitely: the
+dispatcher checks deadlines at take time, the client checks them while
+waiting, and the two sides arbitrate through a tiny claim/shed state
+machine so a request is served exactly once or shed exactly once, never
+both and never neither.
+
+Request lifecycle:
+
+    PENDING --try_claim()--> CLAIMED --deliver()/fail()--> done
+       \\--try_shed()--> SHED (fail(DeadlineExceededError))
+
+`try_claim` (dispatcher) and `try_shed` (client timeout, or the
+dispatcher's take-time expiry sweep) race under the request's lock;
+whoever flips the state first wins. A CLAIMED request is part of an
+in-flight micro-batch and will be delivered (the device gather is
+already paid for); a SHED request's eventual result, if any, is
+discarded by the dispatcher's claim failure.
+"""
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+
+class ServeOverloadError(RuntimeError):
+    """The bounded admission queue is full — backpressure, not a bug.
+
+    Raised synchronously by `AdmissionQueue.submit`; the caller decides
+    whether to retry, drop, or surface the overload. Counted in
+    `serve.rejected_total`."""
+
+
+class DeadlineExceededError(TimeoutError):
+    """A lookup's deadline passed before it was served. Counted in
+    `serve.shed_total`."""
+
+
+_PENDING, _CLAIMED, _SHED = 0, 1, 2
+
+
+class LookupRequest:
+    """One client lookup: the key batch, optional read-your-writes
+    ordering futures, a deadline, and the delivery rendezvous."""
+
+    __slots__ = ("keys", "after", "deadline", "t0", "result", "error",
+                 "_state", "_lock", "_done")
+
+    def __init__(self, keys: np.ndarray, after: Sequence = (),
+                 deadline_s: Optional[float] = None):
+        self.keys = keys
+        # outstanding cross-process write futures of the client's worker:
+        # the coalesced pull is ordered after them, so a client that also
+        # pushes reads its own writes (same `after` contract as
+        # Worker.pull; single-process ordering needs nothing — a push
+        # lands under the server lock before the lookup's gather is
+        # dispatched)
+        self.after: Tuple = tuple(after)
+        self.deadline = None if deadline_s is None \
+            else time.monotonic() + deadline_s
+        self.t0 = time.perf_counter()   # serve.latency_s start
+        self.result: Optional[np.ndarray] = None
+        self.error: Optional[BaseException] = None
+        self._state = _PENDING
+        self._lock = threading.Lock()
+        self._done = threading.Event()
+
+    # -- state machine -------------------------------------------------------
+
+    def expired(self, now: Optional[float] = None) -> bool:
+        return self.deadline is not None and \
+            (time.monotonic() if now is None else now) >= self.deadline
+
+    def try_claim(self) -> bool:
+        """Dispatcher side: move PENDING -> CLAIMED."""
+        with self._lock:
+            if self._state != _PENDING:
+                return False
+            self._state = _CLAIMED
+            return True
+
+    def try_shed(self) -> bool:
+        """Shed side (client timeout / take-time expiry sweep): move
+        PENDING -> SHED. False means a micro-batch already claimed it."""
+        with self._lock:
+            if self._state != _PENDING:
+                return False
+            self._state = _SHED
+            return True
+
+    @property
+    def claimed(self) -> bool:
+        return self._state == _CLAIMED
+
+    # -- delivery ------------------------------------------------------------
+
+    def deliver(self, flat: np.ndarray) -> None:
+        self.result = flat
+        self._done.set()
+
+    def fail(self, exc: BaseException) -> None:
+        self.error = exc
+        self._done.set()
+
+    def wait(self, timeout: Optional[float]) -> bool:
+        return self._done.wait(timeout)
+
+    def take_result(self) -> np.ndarray:
+        if self.error is not None:
+            raise self.error
+        return self.result
+
+
+class AdmissionQueue:
+    """Bounded FIFO of LookupRequests with dispatcher-side micro-batch
+    take. `submit` never blocks: a full queue raises ServeOverloadError
+    immediately (the backpressure contract). `take` blocks until at least
+    one live request exists, then lingers up to `max_wait_s` to coalesce
+    more — the micro-batch window.
+
+    Metrics (registered in the server's registry, `shared=True` so a
+    plane torn down and rebuilt on the same server reuses them):
+    `serve.queue_depth` gauge, `serve.rejected_total` /
+    `serve.shed_total` counters."""
+
+    def __init__(self, bound: int, registry=None):
+        assert bound >= 1, "admission queue bound must be >= 1"
+        self.bound = int(bound)
+        self._q: "collections.deque[LookupRequest]" = collections.deque()
+        self._cond = threading.Condition()
+        self._closed = False
+        from ..obs.metrics import Counter
+        if registry is not None and registry.enabled:
+            self.c_rejected = registry.counter("serve.rejected_total",
+                                               shared=True)
+            self.c_shed = registry.counter("serve.shed_total", shared=True)
+            registry.gauge("serve.queue_depth", fn=self.depth,
+                           shared=True)
+        else:
+            # standalone counters: shed/reject accounting survives
+            # --sys.metrics 0 (the session reads c_shed for its own
+            # bookkeeping either way)
+            self.c_rejected = Counter("serve.rejected_total")
+            self.c_shed = Counter("serve.shed_total")
+
+    def depth(self) -> int:
+        """LIVE (still-pending) requests queued — the number that counts
+        against the bound. Client-shed corpses linger in the deque until
+        a take or an at-bound submit compacts them; counting them here
+        would let readiness report a saturated queue that the very next
+        submit would admit into. Under the lock — iterating the deque
+        while the dispatcher poplefts would raise 'deque mutated during
+        iteration'. O(queue bound), probe-frequency only."""
+        with self._cond:
+            return sum(1 for r in self._q if r._state == _PENDING)
+
+    # -- producer (client sessions) ------------------------------------------
+
+    def submit(self, req: LookupRequest) -> None:
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("serve plane is closed")
+            if len(self._q) >= self.bound:
+                # client-shed requests linger in the deque until a take
+                # skips them; they must not count against the bound
+                # (only LIVE requests are backpressure), so compact
+                # before rejecting
+                self._q = collections.deque(
+                    r for r in self._q if r._state == _PENDING)
+            if len(self._q) >= self.bound:
+                self.c_rejected.inc()
+                raise ServeOverloadError(
+                    f"serve admission queue full ({self.bound} pending): "
+                    f"backpressure — retry later, shed load, or raise "
+                    f"--sys.serve.queue")
+            self._q.append(req)
+            self._cond.notify()
+
+    # -- consumer (the LookupBatcher dispatcher thread) ----------------------
+
+    def _pop_live_locked(self) -> Optional[LookupRequest]:
+        """Next claimable request; sheds expired ones on the way (the
+        take-time deadline check). Caller holds the condition lock."""
+        while self._q:
+            r = self._q.popleft()
+            if r.expired():
+                if r.try_shed():
+                    self.c_shed.inc()
+                    r.fail(DeadlineExceededError(
+                        "lookup deadline expired before dispatch "
+                        "(queue wait exceeded deadline_ms)"))
+                continue
+            if r.try_claim():
+                return r
+            # client shed it while queued: already failed, skip
+        return None
+
+    def take(self, max_batch: int, max_wait_s: float):
+        """Claim up to `max_batch` live requests: block for the first,
+        then linger up to `max_wait_s` for more. Returns [] only when
+        the queue is closed (the dispatcher's exit signal)."""
+        with self._cond:
+            while True:
+                first = self._pop_live_locked()
+                if first is not None:
+                    break
+                if self._closed:
+                    return []
+                self._cond.wait()
+            out = [first]
+            if max_wait_s > 0 and len(out) < max_batch:
+                limit = time.monotonic() + max_wait_s
+                while len(out) < max_batch and not self._closed:
+                    nxt = self._pop_live_locked()
+                    if nxt is not None:
+                        out.append(nxt)
+                        continue
+                    rem = limit - time.monotonic()
+                    if rem <= 0:
+                        break
+                    self._cond.wait(rem)
+            else:
+                # zero-wait window: drain whatever is already queued
+                while len(out) < max_batch:
+                    nxt = self._pop_live_locked()
+                    if nxt is None:
+                        break
+                    out.append(nxt)
+            return out
+
+    def close(self) -> None:
+        """Stop admitting, wake the dispatcher, and fail-stop every
+        still-pending request (never leave a waiter hanging)."""
+        with self._cond:
+            self._closed = True
+            pending = list(self._q)
+            self._q.clear()
+            self._cond.notify_all()
+        for r in pending:
+            if r.try_shed():
+                r.fail(RuntimeError("serve plane closed while the "
+                                    "request was queued"))

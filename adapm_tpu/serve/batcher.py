@@ -1,0 +1,174 @@
+"""Micro-batching coalescer: many concurrent lookups -> one fused gather.
+
+DLRM-style inference is dominated by the embedding lookup path, and a
+dedicated request-coalescing layer in front of the parameter store is
+the standard lever (GraphVite's batched sample/lookup pipeline,
+PAPERS.md; "Dissecting Embedding Bag Performance in DLRM Inference").
+The `LookupBatcher` runs one dispatcher thread that
+
+  1. takes up to `--sys.serve.max_batch` requests from the admission
+     queue, lingering at most `--sys.serve.max_wait_us` after the first
+     (the micro-batch window — while a batch's gather is in flight the
+     queue refills, so sustained load coalesces without waiting);
+  2. DEDUPLICATES the union key set (concurrent clients hit the same hot
+     rows; the device gathers one row per unique key, not per request);
+  3. dispatches ONE fused gather per length class through the exact
+     Pull machinery the training path uses — the routing-plan cache,
+     `Server._plan_pull`, and `Server._pull` under the server lock —
+     and scatters the union result back to each request.
+
+Consistency contract (docs/SERVING.md): the plan is computed
+optimistically outside the lock against a `topology_version` snapshot
+and REVALIDATED under the lock at take time, exactly like `Worker.pull`
+(PR 1's staged-pull discipline); the per-class gathers are single
+device programs enqueued under the lock, so every key in a coalesced
+batch is read from the same pool state (no torn batches — a concurrent
+push is a whole program ordered before or after the gather, never
+interleaved). A serve lookup is therefore bit-identical to a plain
+`Worker.pull` of the same keys at the same point in dispatch order,
+across concurrent relocations and sync rounds (pinned by
+tests/test_serve.py's storm test).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import List, Optional
+
+import numpy as np
+
+from ..obs.metrics import BATCH_SIZE_BOUNDS, SERVE_LATENCY_BOUNDS_S
+from .admission import AdmissionQueue, LookupRequest
+
+
+class LookupBatcher:
+    """Owns the dispatcher thread; one per ServePlane."""
+
+    def __init__(self, server, opts, queue: AdmissionQueue,
+                 shard: int = 0):
+        self.server = server
+        self.opts = opts
+        self.queue = queue
+        # the shard serve lookups route from: a local replica there is
+        # preferred, otherwise the owner row is gathered directly (the
+        # pools are one global sharded array, so any shard's rows are
+        # one gather away in a single process)
+        self.shard = int(shard)
+        self._thread: Optional[threading.Thread] = None
+        reg = server.obs
+        # shared=True: a plane rebuilt on the same server reuses the
+        # metrics (single-registration discipline, docs/OBSERVABILITY.md)
+        self.c_lookups = reg.counter("serve.lookups_total", shared=True)
+        self.c_batches = reg.counter("serve.batches_total", shared=True)
+        self.c_keys = reg.counter("serve.keys_total", shared=True)
+        self.c_keys_unique = reg.counter("serve.keys_deduped_total",
+                                         shared=True)
+        self.h_latency = reg.histogram("serve.latency_s",
+                                       bounds=SERVE_LATENCY_BOUNDS_S,
+                                       shared=True)
+        self.h_batch = reg.histogram("serve.batch_size", unit="requests",
+                                     bounds=BATCH_SIZE_BOUNDS, shared=True)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="adapm-serve")
+        self._thread.start()
+
+    def stop(self) -> None:
+        """Close the queue (failing queued requests loudly) and join.
+        A dispatcher that does not exit within the join bound is WEDGED
+        (e.g. blocked on a dead remote owner's pull future) and still
+        reads through the server's pools — proceeding into pool
+        teardown would be a use-after-teardown, so this fail-stops
+        loudly instead (docs/failure_handling.md) and keeps the thread
+        handle (is_alive()/readiness stay truthful)."""
+        self.queue.close()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=30)
+            if t.is_alive():
+                from ..utils import alog
+                alog("[serve] dispatcher failed to exit within 30s — "
+                     "wedged mid-dispatch (dead remote owner?)")
+                raise RuntimeError(
+                    "serve dispatcher wedged: did not exit within 30s "
+                    "of queue close; refusing to proceed into pool "
+                    "teardown under a live reader")
+            self._thread = None
+
+    def is_alive(self) -> bool:
+        t = self._thread
+        return t is not None and t.is_alive()
+
+    # -- dispatcher ----------------------------------------------------------
+
+    def _loop(self) -> None:
+        max_batch = self.opts.serve_max_batch
+        max_wait_s = self.opts.serve_max_wait_us * 1e-6
+        while True:
+            reqs = self.queue.take(max_batch, max_wait_s)
+            if not reqs:
+                return  # queue closed
+            try:
+                self._serve_batch(reqs)
+            except BaseException as e:  # noqa: BLE001 — the dispatcher
+                # must outlive any one batch: fail the batch's waiters
+                # loudly (never leave a claimed request undelivered) and
+                # keep serving
+                for r in reqs:
+                    if not r._done.is_set():
+                        r.fail(e)
+
+    def _serve_batch(self, reqs: List[LookupRequest]) -> None:
+        srv = self.server
+        self.c_batches.inc()
+        self.h_batch.observe(float(len(reqs)))
+        if len(reqs) == 1:
+            allk = reqs[0].keys
+        else:
+            allk = np.concatenate([r.keys for r in reqs])
+        union = np.unique(allk)
+        after = tuple(f for r in reqs for f in r.after)
+        try:
+            flat = self._lookup_union(union, after)
+        except BaseException as e:  # noqa: BLE001 — fail every waiter
+            for r in reqs:
+                r.fail(e)
+            return
+        # scatter the deduplicated union back to each request's keys
+        # (duplicates within a request fan out here, like Worker.pull)
+        from ..parallel.pm import _offsets, _select_flat
+        lens_u = srv.value_lengths[union]
+        offs_u = _offsets(lens_u)
+        self.c_keys_unique.inc(len(union))
+        now = time.perf_counter()
+        for r in reqs:
+            pos = np.searchsorted(union, r.keys)
+            r.deliver(_select_flat(flat, offs_u, lens_u, pos))
+            self.c_lookups.inc()
+            self.c_keys.inc(len(r.keys))
+            self.h_latency.observe(now - r.t0)
+
+    def _lookup_union(self, keys: np.ndarray, after) -> np.ndarray:
+        """One coalesced pull of the (unique, sorted) union batch — the
+        `Worker._pull_op` sequence minus per-worker staging: optimistic
+        plan via the shared routing-plan cache, topology_version
+        revalidation under the lock, `Server._pull` dispatch."""
+        srv = self.server
+        with srv._span("serve.lookup"):
+            plan, tv = None, -1
+            if srv.opts.optimistic_routing:
+                tv = srv.topology_version
+                plan = srv._plan_cached(
+                    "pull", self.shard, keys, tv,
+                    lambda: srv._plan_pull(keys, self.shard))
+            with srv._lock:
+                if plan is not None and srv.topology_version != tv:
+                    plan = None  # topology moved underneath us: re-plan
+                groups, _, remote = srv._pull(keys, self.shard,
+                                              after=after, plan=plan)
+            return srv._assemble_flat(keys, groups, remote=remote)

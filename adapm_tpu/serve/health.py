@@ -1,0 +1,103 @@
+"""Liveness/readiness surface for the serving plane.
+
+Per docs/failure_handling.md, heartbeats (`--sys.heartbeat`) and
+`Server.dead_nodes()` are DETECTION-ONLY: a stale peer is reported, not
+replaced. The serve plane folds that detection into a readiness signal
+a load balancer can act on — a process with stale peers (its lookups
+may observe arbitrarily stale replicas of remotely-owned keys, and
+cross-process pulls may block on a dead owner) reports not-ready while
+continuing to serve in-flight and local traffic; nothing hangs.
+
+Readiness folds three signals:
+  - the dispatcher thread is running (a dead dispatcher serves nothing);
+  - the admission queue is not saturated (depth < bound — a full queue
+    is rejecting new work);
+  - no peer's heartbeat has gone stale (`Server.dead_nodes`; empty when
+    heartbeats are off or single-process, matching the reference's
+    opt-in contract).
+
+The `serve.ready` (0/1) and `serve.dead_peers` gauges land in
+`Server.metrics_snapshot()["serve"]` (schema_version 3), and
+`metrics_snapshot` additionally embeds the full `readiness()` dict when
+a plane is attached, so one snapshot answers "can this process take
+traffic and why not".
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+
+class HealthMonitor:
+    """Owned by a ServePlane; see module docstring."""
+
+    def __init__(self, plane, max_age_s: float = 10.0,
+                 dead_nodes_fn: Optional[Callable[[], list]] = None):
+        self.plane = plane
+        self.server = plane.server
+        self.max_age_s = max_age_s
+        # injectable for tests (and for deployments with an external
+        # failure detector); default: the server's heartbeat-staleness
+        # detection
+        self._dead_nodes_fn = dead_nodes_fn or \
+            (lambda: self.server.dead_nodes(self.max_age_s))
+        # last readiness() result + its wall time: the gauges below read
+        # this (refreshing past _GAUGE_MAX_AGE_S) instead of each paying
+        # their own dead-peer probe — multi-process, one probe is a
+        # coordinator KV read per peer, and one metrics_snapshot()
+        # otherwise runs it once per gauge plus once for the embedded
+        # readiness dict
+        self._cache = None
+        reg = self.server.obs
+        reg.gauge("serve.ready", shared=True,
+                  fn=lambda: int(self._cached()["ready"]))
+        reg.gauge("serve.dead_peers", shared=True,
+                  fn=lambda: len(self._cached()["dead_nodes"]))
+
+    _GAUGE_MAX_AGE_S = 1.0
+
+    def _cached(self) -> Dict:
+        """The readiness dict for gauge reads: fresh enough, probing at
+        most once per _GAUGE_MAX_AGE_S. metrics_snapshot() calls
+        readiness() first, so one snapshot performs exactly one probe
+        and its gauges agree with its embedded readiness dict."""
+        import time
+        c = self._cache
+        if c is not None and time.monotonic() - c[0] < \
+                self._GAUGE_MAX_AGE_S:
+            return c[1]
+        return self.readiness()
+
+    def _dead(self) -> List:
+        try:
+            return list(self._dead_nodes_fn())
+        except Exception:  # noqa: BLE001 — a failing probe is itself
+            # a not-ready signal, not a crash in the metrics path
+            return ["<heartbeat probe failed>"]
+
+    def liveness(self) -> Dict:
+        """Process-is-up probe: cheap, no cross-process calls."""
+        return {"alive": True,
+                "dispatcher_alive": self.plane.batcher.is_alive()}
+
+    def readiness(self) -> Dict:
+        """Can this process take NEW serving traffic, and if not, why.
+        Always probes fresh (and refreshes the gauge cache)."""
+        import time
+        reasons: List[str] = []
+        if not self.plane.batcher.is_alive():
+            reasons.append("dispatcher thread not running")
+        depth = self.plane.queue.depth()   # live requests only
+        bound = self.plane.queue.bound
+        if depth >= bound:
+            reasons.append(
+                f"admission queue saturated ({depth}/{bound})")
+        dead = self._dead()
+        if dead:
+            reasons.append(
+                f"stale peer heartbeats (detection-only, "
+                f"docs/failure_handling.md): {dead}")
+        out = {"ready": not reasons, "reasons": reasons,
+               "dead_nodes": dead, "queue_depth": depth,
+               "queue_bound": bound}
+        self._cache = (time.monotonic(), out)
+        return out

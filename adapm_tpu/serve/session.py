@@ -1,0 +1,114 @@
+"""Client-facing serving API: `ServeSession.lookup(keys, deadline_ms)`.
+
+A session is a lightweight per-client handle onto a ServePlane. It is
+thread-compatible the way a `Worker` is: one client thread per session
+(sessions are cheap — make one per thread). `lookup` submits into the
+admission queue (raising `ServeOverloadError` under backpressure) and
+blocks until the coalescing dispatcher delivers the values or the
+deadline sheds the request.
+
+Read-your-writes: a session constructed with `worker=` belongs to a
+client that also pushes through that worker. Single-process, nothing is
+needed — a push lands its device program under the server lock before
+the lookup's gather is dispatched, and dispatch order serializes
+programs on the pools. Multi-process, the session forwards the worker's
+outstanding cross-process write futures as the coalesced pull's `after`
+ordering (the same contract `Worker.pull` applies to its own pulls), so
+a push-then-lookup client observes its push even when the pushed key's
+owner is a remote process.
+
+Deadline semantics (docs/SERVING.md "Deadlines"):
+  - checked at dispatcher take time: an expired queued request is shed
+    (`serve.shed_total`) with `DeadlineExceededError`;
+  - checked while the client waits: on timeout the client sheds the
+    request itself if no micro-batch claimed it yet;
+  - a request already CLAIMED by an in-flight micro-batch completes and
+    its (slightly late) values are returned — the device gather is
+    already paid for and the result is correct; deadlines gate queueing
+    and dispatch, not a gather in flight. A wedged dispatcher is
+    fail-stopped by a bounded grace wait (`RuntimeError`), never an
+    indefinite hang.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from .admission import DeadlineExceededError, LookupRequest
+
+# bounded grace for a CLAIMED request's in-flight delivery: a device
+# gather is milliseconds; a dispatcher that cannot deliver within this
+# is wedged and the lookup fail-stops instead of hanging
+_CLAIMED_GRACE_S = 30.0
+
+
+class ServeSession:
+    """One client's handle; obtained from `ServePlane.session()`."""
+
+    def __init__(self, plane, worker=None):
+        self.plane = plane
+        self.server = plane.server
+        self.worker = worker
+
+    def lookup(self, keys, deadline_ms: Optional[float] = None,
+               out: Optional[np.ndarray] = None) -> np.ndarray:
+        """Coalesced, snapshot-consistent read of `keys` (any shape;
+        duplicates allowed — values come back per input position).
+        Returns [B, L] when the batch is uniform-length, else the flat
+        per-key concat (the `Worker.pull_sync` shapes). `deadline_ms`
+        defaults to `--sys.serve.deadline_ms` (0 = no deadline).
+
+        Raises `ServeOverloadError` (queue full — backpressure),
+        `DeadlineExceededError` (shed), or `RuntimeError` (plane closed
+        / dispatcher wedged). Never hangs."""
+        keys = np.ascontiguousarray(
+            np.asarray(keys, dtype=np.int64).ravel())
+        srv = self.server
+        if len(keys) == 0:
+            return np.empty(0, dtype=np.float32)
+        # validate at the session boundary: an out-of-range key must
+        # fail ITS client loudly, not poison the co-batched requests of
+        # other clients inside the dispatcher
+        from ..base import check_key_range
+        check_key_range(keys, srv.num_keys)
+        lens = srv.value_lengths[keys]
+        if deadline_ms is None:
+            deadline_ms = self.plane.opts.serve_deadline_ms
+        deadline_s = None if not deadline_ms else deadline_ms * 1e-3
+        after = ()
+        if self.worker is not None and srv.glob is not None:
+            after = tuple(self.worker._live_write_futs())
+        req = LookupRequest(keys, after=after, deadline_s=deadline_s)
+        self.plane.queue.submit(req)  # may raise ServeOverloadError
+        if not req.wait(deadline_s):
+            # deadline passed while we waited: shed if still unclaimed
+            if req.try_shed():
+                self.plane.queue.c_shed.inc()
+                raise DeadlineExceededError(
+                    f"lookup deadline ({deadline_ms} ms) expired before "
+                    f"a micro-batch claimed the request "
+                    f"(queue depth {self.plane.queue.depth()})")
+            # claimed: an in-flight batch will deliver — bounded grace
+            if not req.wait(_CLAIMED_GRACE_S):
+                raise RuntimeError(
+                    "serve dispatcher failed to deliver a claimed "
+                    f"request within {_CLAIMED_GRACE_S}s — wedged "
+                    "dispatcher (fail-stop, docs/failure_handling.md)")
+        flat = req.take_result()  # raises the shed/close error if any
+        if out is not None:
+            # reshape(-1) on a non-contiguous view would COPY and the
+            # caller's buffer would silently stay unfilled; a too-small
+            # buffer would fail with an opaque broadcast error
+            if not out.flags["C_CONTIGUOUS"]:
+                raise ValueError(
+                    "lookup out= buffer must be C-contiguous (got a "
+                    "strided view; pass np.ascontiguousarray(out))")
+            if out.size < len(flat):
+                raise ValueError(
+                    f"lookup out= buffer too small: {out.size} < "
+                    f"{len(flat)} values for this key batch")
+            np.copyto(out.reshape(-1)[: len(flat)], flat)
+        if len(np.unique(lens)) == 1:
+            return flat.reshape(len(keys), int(lens[0]))
+        return flat

@@ -357,6 +357,112 @@ def bench_mgmt(replicas=50_000, vlen=16, rounds=40, trickle=512):
     return out
 
 
+def bench_serve(E=20_000, vlen=32, clients=32, lookups_per_client=40,
+                B=64):
+    """Online-serving phase (ISSUE 4): closed-loop load generator — N
+    client threads each issuing `lookups_per_client` coalesced
+    `ServeSession.lookup` calls of B skewed keys — against the
+    sequential per-request `Worker.pull_sync` baseline (one request at
+    a time, the pre-serve API). Reports QPS for both, the coalescing
+    gain, P50/P99 lookup latency (serve.latency_s via hist_percentile),
+    micro-batch shape, and a deadline-overload segment that must SHED
+    (serve.shed_total > 0) instead of hanging."""
+    import threading
+
+    import adapm_tpu
+    from adapm_tpu.config import SystemOptions
+    from adapm_tpu.obs.metrics import hist_percentile
+    from adapm_tpu.serve import (DeadlineExceededError, ServeOverloadError,
+                                 ServePlane)
+
+    _progress(f"serve phase: building server ({E} keys, {clients} clients)")
+    srv = adapm_tpu.setup(E, vlen,
+                          opts=SystemOptions(sync_max_per_sec=0,
+                                             prefetch=False))
+    w = srv.make_worker(0)
+    rng = np.random.default_rng(0)
+    slab = 50_000
+    for lo in range(0, E, slab):
+        hi = min(lo + slab, E)
+        w.set(np.arange(lo, hi),
+              rng.normal(size=(hi - lo, vlen)).astype(np.float32))
+    srv.block()
+    total = clients * lookups_per_client
+    batches = [[_skewed_keys(rng, E, B) for _ in range(lookups_per_client)]
+               for _ in range(clients)]
+
+    # sequential per-request baseline: same total request count, one
+    # pull_sync at a time (warm the gather bucket shape first)
+    w.pull_sync(batches[0][0])
+    _progress("serve phase: sequential baseline")
+    t0 = time.perf_counter()
+    for cb in batches:
+        for b in cb:
+            w.pull_sync(b)
+    t_seq = time.perf_counter() - t0
+    seq_qps = total / t_seq
+
+    plane = ServePlane(srv)
+    sess0 = plane.session()
+    sess0.lookup(batches[0][0])  # warm the coalesced path + compiles
+    lat0 = srv.obs.find("serve.latency_s").snap()["count"]
+    barrier = threading.Barrier(clients + 1)
+    errs: list = []
+
+    def client(ci):
+        try:
+            sess = plane.session()
+            barrier.wait()
+            for b in batches[ci]:
+                sess.lookup(b)
+        except BaseException as e:  # noqa: BLE001
+            errs.append(e)
+
+    threads = [threading.Thread(target=client, args=(ci,))
+               for ci in range(clients)]
+    for t in threads:
+        t.start()
+    _progress("serve phase: closed-loop coalesced load")
+    barrier.wait()
+    t0 = time.perf_counter()
+    for t in threads:
+        t.join()
+    t_coal = time.perf_counter() - t0
+    assert not errs, errs[:3]
+    qps = total / t_coal
+
+    lat = srv.obs.find("serve.latency_s").snap()
+    bsz = srv.obs.find("serve.batch_size").snap()
+    # overload segment: deadlines shorter than the micro-batch queue
+    # wait under a request burst -> requests are shed loudly, never
+    # parked (the acceptance contract). A 0.001 ms deadline is expired
+    # by take time, so sheds are deterministic.
+    shed_before = srv.obs.find("serve.shed_total").value
+    for _ in range(64):
+        try:
+            sess0.lookup(batches[0][0], deadline_ms=0.001)
+        except (DeadlineExceededError, ServeOverloadError):
+            pass
+    shed = srv.obs.find("serve.shed_total").value - shed_before
+    _progress(f"serve phase: {qps:.0f} qps coalesced vs {seq_qps:.0f} "
+              f"sequential, {shed} shed under overload")
+    out = {"clients": clients,
+           "lookups": total,
+           "keys_per_lookup": B,
+           "qps": round(qps, 1),
+           "sequential_qps": round(seq_qps, 1),
+           "coalesce_gain": round(qps / seq_qps - 1.0, 3),
+           "latency_p50_ms": round(1e3 * hist_percentile(lat, 0.50), 3),
+           "latency_p99_ms": round(1e3 * hist_percentile(lat, 0.99), 3),
+           "timed_lookups_in_hist": lat["count"] - lat0,
+           "batch_size_avg": round(bsz["avg"], 2),
+           "batch_size_max": bsz["max"],
+           "shed_total_overload": int(shed),
+           "metrics": srv.metrics_snapshot()}
+    srv.shutdown()
+    return out
+
+
 def bench_w2v(V=100_000, d=128, B=8192, N=5, steps=40, warmup=4,
               scan_steps=1) -> float:
     """word2vec SGNS fused-step throughput (pairs/sec) with on-device
@@ -583,6 +689,17 @@ def _phase_mgmt():
     return out
 
 
+def _phase_serve():
+    import jax
+    sz = {"E": 8_000, "lookups_per_client": 20} \
+        if os.environ.get("ADAPM_BENCH_SMALL") else {}
+    out = bench_serve(**sz)
+    out["virtual_shards"] = len(jax.devices("cpu"))
+    if sz:
+        out["small_sizes"] = sz
+    return out
+
+
 def _phase_w2v():
     if os.environ.get("ADAPM_BENCH_SMALL"):
         small = dict(V=20_000, d=64, B=2048, warmup=2)
@@ -612,12 +729,13 @@ def _phase_cpu():
 _PHASES = {"probe": _phase_probe, "kge": _phase_kge,
            "prefetch": _phase_prefetch, "scan": _phase_scan,
            "dedup": _phase_dedup, "pm": _phase_pm, "mgmt": _phase_mgmt,
-           "w2v": _phase_w2v, "cpu": _phase_cpu}
+           "serve": _phase_serve, "w2v": _phase_w2v, "cpu": _phase_cpu}
 
 # generous per-phase walls: a healthy phase finishes in a fraction of
 # these; a wedged relay burns one wall once, then the driver degrades
 _TIMEOUTS = {"probe": 120, "kge": 1200, "prefetch": 1200, "scan": 900,
-             "dedup": 900, "pm": 900, "mgmt": 900, "w2v": 900, "cpu": 600}
+             "dedup": 900, "pm": 900, "mgmt": 900, "serve": 900,
+             "w2v": 900, "cpu": 600}
 
 _CPU_ENV = {"JAX_PLATFORMS": "cpu", "ADAPM_PLATFORM": "cpu",
             "ADAPM_BENCH_SMALL": "1"}
@@ -724,6 +842,10 @@ def main():
     mgmt_env = dict(pm_env)
     mgmt_env.pop("ADAPM_BENCH_SMALL", None)
     results["mgmt"] = _run_phase("mgmt", mgmt_env)
+    # online-serving phase (ISSUE 4): host-CPU by design — the coalescer
+    # and admission queue are host-side, and the comparison against
+    # sequential per-request pulls needs both paths on the same backend
+    results["serve"] = _run_phase("serve", pm_env)
     results["cpu"] = _run_phase("cpu")
 
     def phase_val(name, field):
@@ -786,6 +908,8 @@ def main():
         "pm": pm,
         "mgmt": (results["mgmt"] if _ok(results["mgmt"])
                  else {"error": "mgmt failed"}),
+        "serve": (results["serve"] if _ok(results["serve"])
+                  else {"error": "serve failed"}),
         "w2v_pairs_per_sec": round(w2v, 1),
         "dedup": {"unique_batch_triples_per_sec": round(tput_unique, 1),
                   "gain_vs_skewed":

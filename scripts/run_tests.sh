@@ -20,5 +20,9 @@ python scripts/metrics_overhead_check.py
 # must stay a small fraction of the per-key-Python shadow cost —
 # reintroduced set/fromiter/listcomp hot loops cost a multiple
 python scripts/mgmt_plane_check.py
+# serving-plane guard (ISSUE 4): coalesced lookups at 32 concurrent
+# clients must beat sequential per-request pulls, and an idle serve
+# loop must dispatch zero device programs
+python scripts/serve_latency_check.py
 python -m pytest tests/ -q "$@"
 echo "ALL TESTS PASSED"

@@ -128,6 +128,50 @@ def test_dcn_threads_sizes_pm_executors():
     assert executor_widths(wide) == (8, 4)
 
 
+def test_serve_knobs_round_trip():
+    """--sys.serve.* parse into the options ServePlane consumes
+    (ISSUE 4 satellite)."""
+    import argparse
+
+    from adapm_tpu.config import SystemOptions
+    p = argparse.ArgumentParser()
+    SystemOptions.add_arguments(p)
+    dflt = SystemOptions.from_args(p.parse_args([]))
+    assert (dflt.serve_max_batch, dflt.serve_max_wait_us,
+            dflt.serve_queue, dflt.serve_deadline_ms) == (64, 200,
+                                                          1024, 0.0)
+    on = SystemOptions.from_args(p.parse_args(
+        ["--sys.serve.max_batch", "16", "--sys.serve.max_wait_us", "500",
+         "--sys.serve.queue", "256", "--sys.serve.deadline_ms", "50"]))
+    assert on.serve_max_batch == 16 and on.serve_max_wait_us == 500
+    assert on.serve_queue == 256 and on.serve_deadline_ms == 50.0
+
+
+def test_serve_knobs_rejected_at_parse_time():
+    """Out-of-range / inconsistent --sys.serve.* combinations fail
+    loudly at parse time, not when the first lookup misbehaves."""
+    import argparse
+
+    import pytest
+
+    from adapm_tpu.config import SystemOptions
+    p = argparse.ArgumentParser()
+    SystemOptions.add_arguments(p)
+    bad = (["--sys.serve.max_batch", "0"],
+           ["--sys.serve.max_wait_us", "-1"],
+           ["--sys.serve.queue", "0"],
+           ["--sys.serve.deadline_ms", "-5"],
+           # inconsistent: queue bound below max_batch makes the
+           # configured batch size unreachable
+           ["--sys.serve.queue", "8", "--sys.serve.max_batch", "16"])
+    for argv in bad:
+        with pytest.raises(ValueError):
+            SystemOptions.from_args(p.parse_args(argv))
+    # hand-built options are validated again at ServePlane construction
+    with pytest.raises(ValueError):
+        SystemOptions(serve_max_batch=-3).validate_serve()
+
+
 def test_collective_sync_knobs():
     """--sys.collective_sync / --sys.collective_bucket parse into the
     options GlobalPM consults when choosing the sync data plane."""

@@ -197,10 +197,11 @@ def test_metrics_snapshot_schema_stable():
     srv, w = _run_instrumented(SystemOptions(sync_max_per_sec=0,
                                              prefetch_pull="always"))
     snap = srv.metrics_snapshot()
-    # the documented schema contract (docs/OBSERVABILITY.md); v2 = the
-    # PR 3 sync-section changes (keys_shipped/keys_considered semantics,
-    # replicas_live/dirty_fraction gauges)
-    assert snap["schema_version"] == 2 and snap["metrics_enabled"]
+    # the documented schema contract (docs/OBSERVABILITY.md); v3 = the
+    # PR 4 serve section (the online serving plane's metrics +
+    # readiness; {} until a ServePlane is attached)
+    assert snap["schema_version"] == 3 and snap["metrics_enabled"]
+    assert snap["serve"] == {}  # no ServePlane on this server
     for sec in srv._SNAPSHOT_SECTIONS:
         assert isinstance(snap[sec], dict), sec
     # v2 sync surface: shipped vs considered + table-occupancy gauges

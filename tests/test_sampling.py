@@ -1,5 +1,8 @@
 """Sampling correctness per scheme (reference tests/test_sampling.cc): value
-correctness of sampled pulls, WOR uniqueness, distribution sanity."""
+correctness of sampled pulls, WOR uniqueness, distribution sanity — and
+sampling under concurrent serve readers (ISSUE 4 satellite)."""
+import threading
+
 import numpy as np
 import pytest
 
@@ -79,6 +82,56 @@ def test_local_scheme_stays_local(ctx):
     local = s.ab.is_local(keys, w.shard)
     assert local.all(), "local scheme sampled a non-local key"
     assert w.stats["pull_params_local"] - before["pull_params_local"] == 50
+
+
+@pytest.mark.parametrize("scheme", ["local", "pool"])
+def test_sampling_races_serve_lookups(ctx, scheme):
+    """PrepareSample / pull_sample racing coalesced serve lookups on the
+    same server (ISSUE 4 satellite): neither path may corrupt the other
+    — sampled pulls keep returning the sampled keys' values, serve
+    lookups stay bit-correct (values are key-id constants, so every
+    read has exactly one right answer), and nothing hangs."""
+    from adapm_tpu.serve import ServePlane
+    s, ws = make(ctx, scheme)
+    plane = ServePlane(s)
+    errs = []
+    stop = threading.Event()
+
+    def looker(seed):
+        sess = plane.session()
+        rng = np.random.default_rng(seed)
+        try:
+            while not stop.is_set():
+                k = rng.integers(0, NK, 8)
+                v = sess.lookup(k)
+                if not np.array_equal(v[:, 0], k.astype(np.float32)):
+                    errs.append(("lookup", k, v[:, 0]))
+                    return
+        except BaseException as e:  # noqa: BLE001
+            errs.append(e)
+
+    threads = [threading.Thread(target=looker, args=(i,))
+               for i in range(2)]
+    for t in threads:
+        t.start()
+    w = ws[1]
+    try:
+        for _ in range(25):
+            h = w.prepare_sample(16)
+            keys, vals = w.pull_sample(h)
+            assert len(keys) == 16
+            # the sampling index survived the racing reads: values
+            # still match the sampled keys exactly
+            np.testing.assert_array_equal(vals[:, 0],
+                                          keys.astype(np.float32))
+            w.finish_sample(h)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=60)
+            assert not t.is_alive(), "serve looker hung"
+    assert not errs, errs[:2]
+    plane.close()
 
 
 def test_distribution_sanity(ctx):

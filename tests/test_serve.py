@@ -1,0 +1,413 @@
+"""Online serving plane (ISSUE 4 tentpole): coalesced read path with
+admission control, deadlines, and snapshot-consistent lookups.
+
+Tier-1 coverage for adapm_tpu/serve:
+
+  - lookup correctness vs `Worker.pull` (duplicates, mixed length
+    classes, empty batches);
+  - micro-batch coalescing: N queued requests -> ONE dispatcher batch,
+    deduplicated union keys;
+  - admission control: a full bounded queue rejects loudly
+    (ServeOverloadError), a passed deadline sheds loudly
+    (DeadlineExceededError) — never a hang;
+  - the ACCEPTANCE storm: randomized interleaving of serve lookups,
+    pushes, sets, relocations, replica churn, and sync rounds — every
+    lookup bit-identical to a plain `Worker.pull` of the same keys at
+    the same point, read-your-writes included;
+  - a concurrent (threaded) storm: serve clients + pushers + a
+    relocator + a sync driver, exact additive-sum invariants, bounded
+    joins (no hang);
+  - readiness: a stale peer heartbeat flips readiness (detection-only,
+    docs/failure_handling.md) WITHOUT hanging the request queue;
+  - the serve section of metrics_snapshot (schema_version 3) and the
+    plane lifecycle (one live plane per server, close/reopen, shutdown
+    closes the plane).
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from adapm_tpu import Server, SystemOptions, make_mesh
+from adapm_tpu.serve import (DeadlineExceededError, LookupRequest,
+                             ServeOverloadError, ServePlane)
+
+NK = 96
+VL = 4
+
+
+@pytest.fixture(scope="module")
+def ctx():
+    return make_mesh(8)
+
+
+def make_server(ctx, num_keys=NK, vlen=VL, **kw):
+    opts = kw.pop("opts", None) or SystemOptions(sync_max_per_sec=0)
+    return Server(num_keys, vlen, opts=opts, ctx=ctx, **kw)
+
+
+def _seed(w, num_keys=NK, vlen=VL):
+    keys = np.arange(num_keys)
+    vals = (np.arange(num_keys * vlen, dtype=np.float32)
+            .reshape(num_keys, vlen))
+    w.wait(w.set(keys, vals))
+    return vals
+
+
+def test_lookup_matches_pull(ctx):
+    s = make_server(ctx)
+    w = s.make_worker(0)
+    _seed(w)
+    with ServePlane(s) as plane:
+        sess = plane.session()
+        for batch in (np.array([1, 5, 9]),
+                      np.array([7, 7, 3, 7]),          # duplicates
+                      np.arange(NK),                    # everything
+                      np.array([42])):
+            got = sess.lookup(batch)
+            ref = w.pull_sync(batch)
+            assert np.array_equal(got, ref), batch
+        assert sess.lookup([]).size == 0
+        # an out-of-range key fails ITS client at the session boundary
+        # (it must not reach the dispatcher and poison a co-batch)
+        with pytest.raises(IndexError):
+            sess.lookup(np.array([NK]))
+        with pytest.raises(IndexError):
+            sess.lookup(np.array([-1]))
+        # the plane still serves after the rejection
+        assert np.array_equal(sess.lookup(np.array([0])),
+                              w.pull_sync(np.array([0])))
+    s.shutdown()
+
+
+def test_lookup_mixed_length_classes(ctx):
+    """Ragged batches span length classes: one fused gather per class,
+    reassembled flat exactly like pull_sync."""
+    lens = np.where(np.arange(32) % 3 == 0, 8, 4)
+    s = Server(32, lens, opts=SystemOptions(sync_max_per_sec=0), ctx=ctx)
+    w = s.make_worker(0)
+    flat = np.arange(lens.sum(), dtype=np.float32)
+    w.wait(w.set(np.arange(32), flat))
+    with ServePlane(s) as plane:
+        sess = plane.session()
+        batch = np.array([0, 1, 3, 6, 2, 0])  # mixed classes + duplicate
+        got = sess.lookup(batch)
+        ref = w.pull_sync(batch)
+        assert got.ndim == 1 and np.array_equal(got, ref)
+    s.shutdown()
+
+
+def test_coalesced_batch_single_dispatch(ctx):
+    """N requests queued while the dispatcher is paused are served by
+    ONE micro-batch: one deduplicated union gather, every request's
+    values correct (deterministic — no timing assumptions)."""
+    s = make_server(ctx)
+    w = s.make_worker(0)
+    vals = _seed(w)
+    plane = ServePlane(s, start=False)
+    reqs = [LookupRequest(np.array([i, i + 1, 40])) for i in range(8)]
+    for r in reqs:
+        plane.queue.submit(r)
+    b0 = s.obs.find("serve.batches_total").value
+    plane.start()
+    for i, r in enumerate(reqs):
+        assert r.wait(30), "request not served"
+        got = r.take_result().reshape(3, VL)
+        assert np.array_equal(got, vals[[i, i + 1, 40]])
+    assert s.obs.find("serve.batches_total").value == b0 + 1
+    assert s.obs.find("serve.batch_size").snap()["max"] == 8.0
+    # the union was deduplicated: 8 requests x 3 keys share key 40 and
+    # overlap pairwise -> far fewer unique keys than submitted keys
+    assert s.obs.find("serve.keys_deduped_total").value < \
+        s.obs.find("serve.keys_total").value
+    plane.close()
+    s.shutdown()
+
+
+def test_backpressure_rejects_loudly(ctx):
+    s = make_server(ctx)
+    w = s.make_worker(0)
+    vals = _seed(w)
+    opts = SystemOptions(sync_max_per_sec=0, serve_queue=4,
+                         serve_max_batch=4)
+    plane = ServePlane(s, opts=opts, start=False)
+    reqs = [LookupRequest(np.array([i])) for i in range(4)]
+    for r in reqs:
+        plane.queue.submit(r)
+    sess = plane.session()
+    with pytest.raises(ServeOverloadError):
+        sess.lookup(np.array([9]))
+    assert s.obs.find("serve.rejected_total").value >= 1
+    # backpressure is transient: once the dispatcher drains, admission
+    # resumes and the queued requests were all served correctly
+    plane.start()
+    for i, r in enumerate(reqs):
+        assert r.wait(30)
+        assert np.array_equal(r.take_result(), vals[i])
+    assert np.array_equal(sess.lookup(np.array([9]))[0], vals[9])
+    plane.close()
+    s.shutdown()
+
+
+def test_deadline_sheds_never_hangs(ctx):
+    s = make_server(ctx)
+    w = s.make_worker(0)
+    vals = _seed(w)
+    plane = ServePlane(s, start=False)  # paused: nothing will serve
+    sess = plane.session()
+    t0 = time.monotonic()
+    with pytest.raises(DeadlineExceededError):
+        sess.lookup(np.array([1]), deadline_ms=30)
+    assert time.monotonic() - t0 < 5.0, "shed was not prompt"
+    assert s.obs.find("serve.shed_total").value >= 1
+    # the shed corpse still sits in the deque, but it is NOT live work:
+    # depth (and hence readiness/queue_depth) must not count it
+    assert plane.queue.depth() == 0
+    # an already-expired request queued behind a live one is shed at
+    # take time (dispatcher-side deadline check), the live one served
+    dead = LookupRequest(np.array([2]), deadline_s=0.0)
+    live = LookupRequest(np.array([3]))
+    plane.queue.submit(dead)
+    plane.queue.submit(live)
+    plane.start()
+    assert live.wait(30)
+    assert np.array_equal(live.take_result(), vals[3])
+    assert dead.wait(30)
+    with pytest.raises(DeadlineExceededError):
+        dead.take_result()
+    # the plane keeps serving after sheds
+    assert np.array_equal(sess.lookup(np.array([4]))[0], vals[4])
+    plane.close()
+    s.shutdown()
+
+
+def test_serve_storm_bit_identical(ctx):
+    """THE acceptance storm: a randomized (but deterministic) sequence
+    of pushes, sets, relocations, replica churn, and sync rounds, with
+    a serve lookup + plain `Worker.pull` of the same keys after every
+    mutation — bit-identical at every read, read-your-writes included
+    (the pull and the lookup route from the same shard as the serving
+    plane, which is the consistency contract; docs/SERVING.md)."""
+    s = make_server(ctx, opts=SystemOptions(sync_max_per_sec=0,
+                                            cache_slots_per_shard=64))
+    w0 = s.make_worker(0)   # shard 0 — the serve plane's shard
+    w1 = s.make_worker(1)   # shard 1 — a second writer + replica holder
+    _seed(w0)
+    plane = ServePlane(s)
+    sess = plane.session(worker=w0)
+    rng = np.random.default_rng(7)
+    for step in range(50):
+        op = rng.integers(0, 6)
+        kset = np.unique(rng.integers(0, NK, rng.integers(1, 9)))
+        if op == 0:
+            w0.push(kset, rng.normal(size=(len(kset), VL))
+                    .astype(np.float32))
+        elif op == 1:
+            w1.push(kset, rng.normal(size=(len(kset), VL))
+                    .astype(np.float32))
+        elif op == 2:
+            w0.set(kset, rng.normal(size=(len(kset), VL))
+                   .astype(np.float32))
+        elif op == 3:
+            s._relocate_to(kset, int(rng.integers(0, s.num_shards)))
+        elif op == 4:
+            # replica churn: a short-lived intent window on shard 1
+            w1.intent(kset, w1.current_clock, w1.current_clock + 2)
+            with s._round_lock:
+                s.sync.run_round(force_intents=True, all_channels=True)
+            w1.advance_clock()
+        else:
+            with s._round_lock:
+                s.sync.run_round(all_channels=True)
+        batch = rng.integers(0, NK, 12)  # duplicates allowed
+        got = sess.lookup(batch)
+        ref = w0.pull_sync(batch)
+        assert np.array_equal(got, ref), f"step {step} (op {op}) diverged"
+    assert s.obs.find("serve.lookups_total").value == 50
+    plane.close()
+    s.shutdown()
+
+
+def test_serve_concurrent_storm_no_hang(ctx):
+    """Concurrent clients, writers, a relocator, and a sync driver: the
+    additive-sum invariant holds exactly (each client's disjoint key
+    slice reads exactly its own push count — coalesced lookups are
+    ordered with the client's pushes), and every thread joins within
+    its bound (reject/shed loudly, never hang)."""
+    s = make_server(ctx, num_keys=64,
+                    opts=SystemOptions(sync_max_per_sec=0))
+    w0, w1 = s.make_worker(0), s.make_worker(1)
+    w0.wait(w0.set(np.arange(64), np.zeros((64, VL), np.float32)))
+    plane = ServePlane(s)
+    errs = []
+    stop = threading.Event()
+
+    def client(w, lo, hi):
+        # pushes land on owner main rows (no replicas of these keys —
+        # no intents are signalled for them), so a coalesced lookup
+        # observes exactly the pushes dispatched before it
+        try:
+            sess = plane.session(worker=w)
+            mine = np.arange(lo, hi)
+            for n in range(1, 31):
+                w.push(mine, np.ones((len(mine), VL), np.float32))
+                got = sess.lookup(mine)
+                if not np.array_equal(
+                        got, np.full((len(mine), VL), float(n))):
+                    errs.append((lo, n, got))
+                    return
+        except BaseException as e:  # noqa: BLE001
+            errs.append(e)
+
+    def relocator():
+        rng = np.random.default_rng(11)
+        try:
+            while not stop.is_set():
+                keys = np.unique(rng.integers(0, 64, 6))
+                s._relocate_to(keys, int(rng.integers(0, s.num_shards)))
+                time.sleep(0.001)
+        except BaseException as e:  # noqa: BLE001
+            errs.append(e)
+
+    def syncer():
+        try:
+            while not stop.is_set():
+                with s._round_lock:
+                    s.sync.run_round(all_channels=True)
+                time.sleep(0.001)
+        except BaseException as e:  # noqa: BLE001
+            errs.append(e)
+
+    threads = [threading.Thread(target=client, args=(w0, 0, 16)),
+               threading.Thread(target=client, args=(w1, 16, 32)),
+               threading.Thread(target=relocator),
+               threading.Thread(target=syncer)]
+    for t in threads[:2]:
+        t.start()
+    for t in threads[2:]:
+        t.start()
+    for t in threads[:2]:
+        t.join(timeout=120)
+        assert not t.is_alive(), "serve client hung"
+    stop.set()
+    for t in threads[2:]:
+        t.join(timeout=60)
+        assert not t.is_alive()
+    assert not errs, errs[:3]
+    plane.close()
+    s.shutdown()
+
+
+def test_readiness_flips_on_stale_peer(ctx):
+    """ISSUE 4 satellite: heartbeat/dead-node detection is DETECTION-
+    ONLY — a stale peer flips the readiness signal while the request
+    queue keeps serving (never hangs)."""
+    s = make_server(ctx)
+    w = s.make_worker(0)
+    vals = _seed(w)
+    dead = []
+    plane = ServePlane(s, dead_nodes_fn=lambda: list(dead))
+    sess = plane.session()
+    r = plane.health.readiness()
+    assert r["ready"] and r["dead_nodes"] == []
+    assert plane.health.liveness()["dispatcher_alive"]
+    # a peer's heartbeat goes stale: not ready, reason names it...
+    dead.append(2)
+    r = plane.health.readiness()
+    assert not r["ready"] and r["dead_nodes"] == [2]
+    assert any("stale peer" in x for x in r["reasons"])
+    snap = s.metrics_snapshot()
+    assert snap["serve"]["ready"] == 0
+    assert snap["serve"]["dead_peers"] == 1
+    assert snap["serve"]["readiness"]["dead_nodes"] == [2]
+    # ...but the queue is NOT hung: lookups still serve promptly
+    t0 = time.monotonic()
+    assert np.array_equal(sess.lookup(np.array([5]))[0], vals[5])
+    assert time.monotonic() - t0 < 10.0
+    # detection clears -> ready again
+    dead.clear()
+    assert plane.health.readiness()["ready"]
+    plane.close()
+    s.shutdown()
+
+
+def test_serve_snapshot_section_and_lifecycle(ctx):
+    s = make_server(ctx)
+    w = s.make_worker(0)
+    _seed(w)
+    # before any plane: the section exists (schema stability) but is {}
+    snap = s.metrics_snapshot()
+    assert snap["schema_version"] == 3 and snap["serve"] == {}
+    plane = ServePlane(s)
+    # one live plane per server
+    with pytest.raises(RuntimeError):
+        ServePlane(s)
+    sess = plane.session()
+    sess.lookup(np.array([1, 2, 3]))
+    snap = s.metrics_snapshot()
+    for key in ("lookups_total", "batches_total", "keys_total",
+                "keys_deduped_total", "latency_s", "batch_size",
+                "queue_depth", "shed_total", "rejected_total", "ready",
+                "dead_peers", "readiness"):
+        assert key in snap["serve"], key
+    assert snap["serve"]["lookups_total"] >= 1
+    assert snap["serve"]["latency_s"]["count"] >= 1
+    plane.close()
+    # close() is loud for queued work and final for this plane...
+    with pytest.raises(RuntimeError):
+        sess.lookup(np.array([1]))
+    # ...but a NEW plane may be built on the same server (shared serve.*
+    # metrics are reused; gauges rebind to the new plane's structures)
+    plane2 = ServePlane(s)
+    assert np.array_equal(plane2.session().lookup(np.array([1])),
+                          w.pull_sync(np.array([1])))
+    assert s.metrics_snapshot()["serve"]["ready"] == 1
+    # Server.shutdown closes an attached plane (no dangling dispatcher)
+    s.shutdown()
+    assert not plane2.batcher.is_alive()
+
+
+def test_serve_works_with_metrics_off(ctx):
+    """--sys.metrics 0: the plane serves correctly on null metrics (the
+    shed/reject accounting degrades to standalone counters)."""
+    s = make_server(ctx, opts=SystemOptions(sync_max_per_sec=0,
+                                            metrics=False))
+    w = s.make_worker(0)
+    vals = _seed(w)
+    plane = ServePlane(s, start=False)
+    sess = plane.session()
+    with pytest.raises(DeadlineExceededError):
+        sess.lookup(np.array([1]), deadline_ms=20)
+    assert plane.queue.c_shed.value >= 1  # standalone counter
+    plane.start()
+    assert np.array_equal(sess.lookup(np.array([8]))[0], vals[8])
+    assert s.metrics_snapshot()["serve"] == {}
+    plane.close()
+    s.shutdown()
+
+
+def test_serve_default_deadline_from_opts(ctx):
+    """--sys.serve.deadline_ms sets the per-request default."""
+    s = make_server(ctx, opts=SystemOptions(sync_max_per_sec=0,
+                                            serve_deadline_ms=25.0))
+    w = s.make_worker(0)
+    _seed(w)
+    plane = ServePlane(s, start=False)
+    sess = plane.session()
+    with pytest.raises(DeadlineExceededError):
+        sess.lookup(np.array([1]))   # default deadline applies
+    # an explicit deadline_ms=0 overrides to "no deadline"
+    req_served = []
+
+    def late():
+        req_served.append(sess.lookup(np.array([2]), deadline_ms=0))
+
+    t = threading.Thread(target=late)
+    t.start()
+    time.sleep(0.1)
+    plane.start()
+    t.join(timeout=30)
+    assert not t.is_alive() and len(req_served) == 1
+    plane.close()
+    s.shutdown()

@@ -101,6 +101,25 @@ class SystemOptions:
     timing_quantile: float = 0.9999
     timing_rounds_lookahead: float = 2.0
 
+    # -- tiered parameter storage (sys.tier.*; adapm_tpu/tier,
+    #    docs/MEMORY.md): split each server's owned keys between a
+    #    capacity-bounded device-hot main pool and a host-resident cold
+    #    store, with intent-driven promotion and a background demotion
+    #    worker. Decouples model size from HBM: the device main pool
+    #    holds --sys.tier.hot_rows rows per shard per length class
+    #    instead of the whole table. Reads/writes of cold rows are
+    #    served correctly-but-slowly through the cold path and remain
+    #    bit-identical to the untiered store. Default off.
+    tier: bool = False
+    # device-resident main rows per shard per length class
+    tier_hot_rows: int = 65536
+    # pin keys inside an active Intent window hot for the window
+    tier_pin_intent: bool = True
+    # demotion batch size / per-shard free-row headroom the maintenance
+    # worker maintains (a promotion that finds headroom never pays a
+    # victim readback on the caller's path)
+    tier_demote_batch: int = 1024
+
     # -- store geometry
     cache_slots_per_shard: int = 0   # 0 = auto (num_keys // num_shards)
     remote_bucket_min: int = 8       # min padded size of the remote op bucket
@@ -181,6 +200,15 @@ class SystemOptions:
             raise ValueError(
                 f"--sys.serve.deadline_ms must be >= 0 "
                 f"(got {self.serve_deadline_ms}; 0 = no deadline)")
+        if self.tier and self.tier_hot_rows < 8:
+            raise ValueError(
+                f"--sys.tier.hot_rows must be >= 8 (got "
+                f"{self.tier_hot_rows}): a hot pool smaller than one "
+                f"padded bucket cannot serve any gather from device")
+        if self.tier and self.tier_demote_batch < 1:
+            raise ValueError(
+                f"--sys.tier.demote_batch must be >= 1 "
+                f"(got {self.tier_demote_batch})")
         if self.serve_queue < self.serve_max_batch:
             raise ValueError(
                 f"inconsistent serve knobs: --sys.serve.queue "
@@ -236,6 +264,14 @@ class SystemOptions:
                        default="auto", choices=["auto", "always", "off"])
         g.add_argument("--sys.plan_cache", dest="sys_plan_cache", type=int,
                        default=64)
+        g.add_argument("--sys.tier", dest="sys_tier", type=int, default=0)
+        g.add_argument("--sys.tier.hot_rows", dest="sys_tier_hot_rows",
+                       type=int, default=65536)
+        g.add_argument("--sys.tier.pin_intent",
+                       dest="sys_tier_pin_intent", type=int, default=1)
+        g.add_argument("--sys.tier.demote_batch",
+                       dest="sys_tier_demote_batch", type=int,
+                       default=1024)
         g.add_argument("--sys.stats.out", dest="sys_stats_out", default=None)
         g.add_argument("--sys.trace.keys", dest="sys_trace_keys", default=None)
         g.add_argument("--sys.stats.locality", dest="sys_stats_locality",
@@ -299,6 +335,10 @@ class SystemOptions:
             prefetch_staging_rows=args.sys_prefetch_staging_rows,
             prefetch_pull=args.sys_prefetch_pull,
             plan_cache_entries=args.sys_plan_cache,
+            tier=bool(args.sys_tier),
+            tier_hot_rows=args.sys_tier_hot_rows,
+            tier_pin_intent=bool(args.sys_tier_pin_intent),
+            tier_demote_batch=args.sys_tier_demote_batch,
             stats_out=args.sys_stats_out,
             trace_keys=args.sys_trace_keys,
             locality_stats=args.sys_stats_locality,

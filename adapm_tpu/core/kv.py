@@ -158,7 +158,9 @@ class Server:
                 int(class_counts[cid]), L, self.ctx, dtype=self.dtype,
                 over_alloc=self.opts.main_over_alloc,
                 cache_slots_per_shard=cache_slots,
-                bucket_min=self.opts.remote_bucket_min))
+                bucket_min=self.opts.remote_bucket_min,
+                tier_hot_rows=(self.opts.tier_hot_rows
+                               if self.opts.tier else 0)))
         self.ab = Addressbook(
             key_class, self.ctx.num_shards,
             [s.main_slots for s in self.stores],
@@ -207,6 +209,17 @@ class Server:
         self._sync_thread: Optional[threading.Thread] = None
         self._sync_stop = threading.Event()
 
+        # tiered parameter storage (ISSUE 5 tentpole; adapm_tpu/tier,
+        # docs/MEMORY.md): device-hot / host-cold main-row residency
+        # with intent-driven promotion. None when --sys.tier is off —
+        # the stores are then plain device pools, zero tier overhead.
+        self.tier = None
+        if self.opts.tier:
+            self.opts.validate_serve()  # tier knob ranges (parse-time
+            # validation is skipped for hand-built SystemOptions)
+            from ..tier.residency import TierManager
+            self.tier = TierManager(self, self.opts)
+
         # routing-plan cache + intent-driven prefetch pipeline (the hot
         # Pull/Push path levers; core/intent.py). Both revalidate against
         # topology_version, i.e. they depend on the _topology_mutation
@@ -240,6 +253,7 @@ class Server:
                 control.start_heartbeat(self.opts.heartbeat_s)
 
         self.sampling = None  # set by enable_sampling_support
+        self._shutdown_done = False  # shutdown() is idempotent
         # online serving plane (adapm_tpu/serve): attached by
         # ServePlane.__init__ so metrics_snapshot can fold readiness in
         # and shutdown can close it; None until a plane is built
@@ -662,8 +676,18 @@ class Server:
         out = np.empty(offs[-1], dtype=np.float32)
         for cid, pos in self._group_by_class(keys):
             ks = keys[pos]
-            host = np.asarray(self.stores[cid].main)   # [S, slots, L]
-            rows = host[self.ab.owner[ks], self.ab.slot[ks]]
+            st = self.stores[cid]
+            if st.res is not None:
+                # tiered: read only the REQUESTED rows (cold store fancy
+                # index + one hot-pool-sized overlay readback) — a full
+                # main_host() copy would transiently double host RAM at
+                # the beyond-HBM sizes tiering exists for
+                from ..tier.coldpath import read_main_rows_bulk
+                rows = read_main_rows_bulk(
+                    st, self.ab.owner[ks], self.ab.slot[ks])
+            else:
+                host = np.asarray(st.main)             # [S, slots, L]
+                rows = host[self.ab.owner[ks], self.ab.slot[ks]]
             _fill_flat(out, offs, lens, pos, rows.ravel())
         return out
 
@@ -1160,6 +1184,25 @@ class Server:
                 self.sync.run_round()
 
     def shutdown(self) -> None:
+        """Deterministic teardown (ISSUE 5 satellite). Order matters —
+        every closed plane reads through the pools the later steps block
+        on, so readers go down strictly before their substrate:
+
+          1. serve plane (stop admitting lookups; dispatcher joins)
+          2. metrics reporter
+          3. prefetch pipeline (staged gathers + delegated rounds)
+          4. tier maintenance worker (demotion readbacks)
+          5. background sync thread
+          6. pool quiesce (block) + sync channel executor
+          7. stats / trace / span export, registry unhook
+          8. cross-process layer
+
+        Idempotent: a second shutdown() is a no-op (each subordinate
+        close is idempotent too, so a test that closed a plane manually
+        and then shuts the server down stays clean)."""
+        if getattr(self, "_shutdown_done", False):
+            return
+        self._shutdown_done = True
         if self._serve_plane is not None:
             # stop admitting lookups first: the serve dispatcher reads
             # through the same pools the teardown below blocks on
@@ -1169,6 +1212,8 @@ class Server:
             self._reporter = None
         if self.prefetch is not None:
             self.prefetch.close()
+        if self.tier is not None:
+            self.tier.close()
         self.stop_sync_thread()
         self.block()
         self.sync.close()
@@ -1228,6 +1273,9 @@ class Server:
             if self._plan_cache is not None:
                 alog("[stats] plan_cache: " + " ".join(
                     f"{k}={v}" for k, v in self._plan_cache.stats().items()))
+            if self.tier is not None:
+                alog("[stats] tier: " + " ".join(
+                    f"{k}={v}" for k, v in self.tier.report().items()))
         if not self.opts.stats_out:
             return []
         from ..parallel import control
@@ -1251,7 +1299,7 @@ class Server:
     # metrics_snapshot() — the schema-stability contract tests pin
     _SNAPSHOT_SECTIONS = ("kv", "prefetch", "plan_cache", "staging",
                           "sync", "pm", "collective", "fused", "spans",
-                          "serve")
+                          "serve", "tier")
 
     def metrics_snapshot(self, drain_device: bool = True) -> Dict:
         """One structured, JSON-serializable telemetry dict for this
@@ -1277,8 +1325,13 @@ class Server:
         serving plane's qps/latency/queue/shed metrics plus the
         liveness/readiness surface (`serve.ready`, `serve.dead_peers`,
         and the embedded `readiness` detail dict when a ServePlane is
-        attached); `{}` when no plane was ever built."""
-        out: Dict = {"schema_version": 3,
+        attached); `{}` when no plane was ever built.
+
+        schema_version 4 (PR 5): new `tier` section — the tiered-
+        storage plane's hot-hit rate, promotions/demotions, hot-pool
+        occupancy gauges, and the cold-serve latency histogram
+        (`tier.cold_serve_s`); `{}` when --sys.tier is off."""
+        out: Dict = {"schema_version": 4,
                      "metrics_enabled": bool(self.obs.enabled)}
         for s in self._SNAPSHOT_SECTIONS:
             out[s] = {}

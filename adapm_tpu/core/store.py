@@ -239,7 +239,8 @@ class ShardedStore:
 
     def __init__(self, num_keys_in_class: int, value_length: int,
                  ctx: MeshContext, dtype=jnp.float32, over_alloc: float = 1.25,
-                 cache_slots_per_shard: int = 0, bucket_min: int = 8):
+                 cache_slots_per_shard: int = 0, bucket_min: int = 8,
+                 tier_hot_rows: int = 0):
         self.value_length = value_length
         self.ctx = ctx
         self.dtype = dtype
@@ -267,15 +268,44 @@ class ShardedStore:
         self.cache_slots = _round8(max(1, cache_slots_per_shard or
                                        per_shard))
 
+        # -- tiered residency (ISSUE 5 tentpole; adapm_tpu/tier) -----------
+        # tier_hot_rows > 0 caps the DEVICE main pool at that many rows
+        # per shard; the authoritative table spans main_slots rows per
+        # shard, with rows beyond the hot set living in the host cold
+        # store (`self.cold`, layout mirroring the pool row format).
+        # Replica cache/delta pools stay fully device-resident. All
+        # index-level ops keep taking (shard, SLOT) coordinates — the
+        # residency map translates slots to hot rows at dispatch time,
+        # so routing plans and the addressbook never see the tier.
+        self.res = None
+        self.cold = None
+        self.tier_hot_hits = 0   # owner-served gather entries, hot
+        self.tier_cold_hits = 0  # owner-served gather entries, cold
+        self.tier_hist = None    # cold-serve latency hist (TierManager)
+        dev_main_slots = self.main_slots
+        if tier_hot_rows > 0:
+            from ..tier.residency import Residency
+            dev_main_slots = _round8(
+                min(self.main_slots, max(8, tier_hot_rows)))
+            self.res = Residency(S, self.main_slots, dev_main_slots)
+            self.cold = np.zeros((S, self.main_slots, value_length),
+                                 dtype=np.dtype(dtype))
+
         sh = ctx.shard0()
         self.main = jax.device_put(
-            jnp.zeros((S, self.main_slots, value_length), dtype), sh)
+            jnp.zeros((S, dev_main_slots, value_length), dtype), sh)
         self.cache = jax.device_put(
             jnp.zeros((S, self.cache_slots, value_length), dtype), sh)
         self.delta = jax.device_put(
             jnp.zeros((S, self.cache_slots, value_length), dtype), sh)
 
         # -- dirty-delta tracking (host-side, PR 3 tentpole) ---------------
+        # NOTE (PR 5, tiering): the epochs below are indexed by SLOT,
+        # not by device row, so the tracking extends to cold rows for
+        # free — a write that lands in the cold store bumps the same
+        # main_epoch[o, os] cell a hot write would, and the dirty-delta
+        # sync filter keeps working across promotions/demotions (which
+        # move values without changing them, hence without bumping).
         # A sync of replica (s, cs) against owner row (o, os) is a
         # bit-for-bit no-op iff its pending delta is zero AND its base
         # still equals the main row. Both facts are tracked on the host
@@ -358,6 +388,10 @@ class ShardedStore:
     def gather(self, o_shard, o_slot, c_shard, c_slot, use_cache):
         n = len(o_shard)
         self.gathers += 1
+        if self.res is not None:
+            from ..tier import coldpath
+            return coldpath.gather_tiered(self, o_shard, o_slot,
+                                          c_shard, c_slot, use_cache)
         a = pad_bucket(n, (o_shard, 0), (o_slot, OOB), (c_shard, 0),
                        (c_slot, OOB), (use_cache, False),
                        minimum=self.bucket_min)
@@ -389,6 +423,11 @@ class ShardedStore:
         if md.any():
             self.delta_dirty[np.asarray(d_shard)[md],
                              np.asarray(d_slot)[md]] = True
+        if self.res is not None:
+            from ..tier import coldpath
+            coldpath.scatter_add_tiered(self, o_shard, o_slot, d_shard,
+                                        d_slot, vals)
+            return
         a = pad_bucket(n, (o_shard, 0), (o_slot, OOB), (d_shard, 0),
                        (d_slot, OOB), minimum=self.bucket_min)
         v = self._vals_bucket(vals, a[0].shape[0])
@@ -409,6 +448,11 @@ class ShardedStore:
             cs, cl = np.asarray(c_shard)[mc], np.asarray(c_slot)[mc]
             self.repl_epoch[cs, cl] = e
             self.delta_dirty[cs, cl] = False
+        if self.res is not None:
+            from ..tier import coldpath
+            coldpath.set_rows_tiered(self, o_shard, o_slot, vals,
+                                     c_shard, c_slot)
+            return
         a = pad_bucket(n, (o_shard, 0), (o_slot, OOB), (c_shard, 0),
                        (c_slot, OOB), minimum=self.bucket_min)
         v = self._vals_bucket(vals, a[0].shape[0])
@@ -421,6 +465,11 @@ class ShardedStore:
         # row's epoch (no sync needed until someone writes)
         self.repl_epoch[c_shard, c_slot] = self.main_epoch[o_shard, o_slot]
         self.delta_dirty[c_shard, c_slot] = False
+        if self.res is not None:
+            from ..tier import coldpath
+            coldpath.replica_create_tiered(self, o_shard, o_slot,
+                                           c_shard, c_slot)
+            return
         a = pad_bucket(n, (o_shard, 0), (o_slot, OOB), (c_shard, 0),
                        (c_slot, OOB), minimum=self.bucket_min)
         self.cache, self.delta = _replica_create(
@@ -448,6 +497,12 @@ class ShardedStore:
         # host cannot know which deltas merged or which bases refreshed —
         # leave the tracking untouched (replicas stay dirty and are
         # re-considered every round, the pre-filter behavior)
+        if self.res is not None:
+            from ..tier import coldpath
+            coldpath.sync_replicas_tiered(self, r_shard, r_cslot,
+                                          o_shard, o_slot,
+                                          threshold=threshold)
+            return
         a = pad_bucket(n, (r_shard, 0), (r_cslot, OOB), (o_shard, 0),
                        (o_slot, OOB), minimum=self.bucket_min)
         if threshold > 0.0:
@@ -471,6 +526,12 @@ class ShardedStore:
         if mr.any():  # upgraded replica slot is freed; leave it clean
             self.delta_dirty[np.asarray(rc_shard)[mr],
                              np.asarray(rc_slot)[mr]] = False
+        if self.res is not None:
+            from ..tier import coldpath
+            coldpath.relocate_tiered(self, old_shard, old_slot,
+                                     new_shard, new_slot,
+                                     rc_shard, rc_slot)
+            return
         a = pad_bucket(n, (old_shard, 0), (old_slot, OOB), (new_shard, 0),
                        (new_slot, OOB), (rc_shard, 0), (rc_slot, OOB),
                        minimum=self.bucket_min)
@@ -480,12 +541,43 @@ class ShardedStore:
 
     def read_rows(self, which: str, sh, sl) -> np.ndarray:
         """Host readback of pool rows (non-destructive). `which` selects the
-        pool; padding rows are dropped from the result."""
+        pool; padding rows are dropped from the result. Slot-indexed for
+        "main" — tier-aware (hot rows via a device gather, cold rows
+        from the host cold store)."""
+        if which == "main" and self.res is not None:
+            from ..tier import coldpath
+            return coldpath.read_main_rows_tiered(self, sh, sl)
         n = len(sh)
         a = pad_bucket(n, (sh, 0), (sl, OOB), minimum=self.bucket_min)
         arr = {"main": self.main, "cache": self.cache,
                "delta": self.delta}[which]
         return np.asarray(_read_rows_at(arr, *a))[:n]
+
+    # -- tiered-residency helpers (adapm_tpu/tier; no-ops untiered) ----------
+
+    def read_hot_rows_at(self, sh: np.ndarray, row: np.ndarray) -> np.ndarray:
+        """Host readback of hot-pool rows by DEVICE ROW index (the
+        demotion/relocation readback; non-destructive)."""
+        n = len(sh)
+        a = pad_bucket(n, (sh, 0), (row, OOB), minimum=self.bucket_min)
+        return np.asarray(_read_rows_at(self.main, *a))[:n]
+
+    def main_host(self) -> np.ndarray:
+        """The full authoritative main table [S, main_slots, L] on host
+        (checkpoint save, bulk reads) — one whole-pool copy untiered,
+        cold store overlaid with the hot pool's rows tiered."""
+        if self.res is None:
+            return np.asarray(self.main)
+        from ..tier import coldpath
+        return coldpath.main_full_host(self)
+
+    @property
+    def main_shape_full(self):
+        """Shape of the authoritative main table (checkpoint geometry —
+        identical whether or not the store is tiered, so checkpoints
+        restore across tier configurations)."""
+        S = self.ctx.num_shards
+        return (S, self.main_slots, self.value_length)
 
     def install_replica_rows(self, c_shard, c_slot, vals) -> None:
         n = len(c_shard)

@@ -332,6 +332,14 @@ class SyncManager:
                     with self.server._lock:
                         self.replica_add(created, w.shard)
                     self.stats.add(replicas_created=len(created))
+                if self.server.tier is not None:
+                    # tiered storage (adapm_tpu/tier): pin the intent
+                    # batch's owner rows hot for the window and queue
+                    # their promotion — the same just-in-time hook the
+                    # prefetch pipeline rides, and AFTER the relocate/
+                    # replicate actions above so the pins land on the
+                    # keys' final placement
+                    self.server.tier.note_intent(keys, end)
 
     # ------------------------------------------------------------------
     # replica registry (the channel tables; callers hold the server lock)

@@ -98,6 +98,28 @@ def build_routes(server, keys: np.ndarray, shard: int,
     server.ensure_local(keys, shard)
     o_sh, o_sl, c_sh, c_sl, use_c, n_remote, _ = server._route(keys, shard)
     g_sl = np.where(use_c, OOB, o_sl).astype(np.int32)
+    if server.tier is not None:
+        # tiered storage: the step program indexes the DEVICE hot pool,
+        # so every owner-served key must be hot before dispatch. The
+        # runners pin their whole batch as one union up front
+        # (pin_step_keys), so the translation below normally finds
+        # everything hot — the forced ensure only runs for rows still
+        # cold (direct build_routes callers that skipped the union pin)
+        cid = expect_class if expect_class is not None else \
+            int(server.ab.key_class[keys.ravel()[0]])
+        res = server.stores[cid].res
+        slot_flat = g_sl.ravel()            # slots; OOB where replica-served
+        o_flat = o_sh.ravel()
+        m = slot_flat != OOB
+        row = slot_flat.copy()
+        row[m] = res.dev_row[o_flat[m], slot_flat[m]]
+        if (row[m] < 0).any():
+            server.tier.ensure_hot(cid, o_flat[m], slot_flat[m],
+                                   pin_end=server.tier.step_pin_end(),
+                                   force=True)
+            row[m] = res.dev_row[o_flat[m], slot_flat[m]]
+        g_sl = np.where(row < 0, OOB, row).reshape(
+            g_sl.shape).astype(np.int32)
     put = server.ctx.put_replicated  # the staging rule, mesh.py
     return Routes(put(o_sh), put(g_sl), put(c_sh), put(c_sl), put(use_c),
                   n_remote)
@@ -198,21 +220,30 @@ class DeviceRouter:
     def __init__(self, server, shard: int):
         self.server = server
         self.shard = shard
-        self._version = -1
+        self._version = None   # (topology_version, residency epoch)
         self.owner = None      # [num_keys] int32
         self.slot = None       # [num_keys] int32
         self.cache_row = None  # [num_keys] int32 (this shard's replica slots)
 
     def refresh(self):
         srv = self.server
-        if self._version == srv.topology_version and self.owner is not None:
+        ver = (srv.topology_version,
+               srv.tier.epoch if srv.tier is not None else -1)
+        if self._version == ver and self.owner is not None:
             return
         ab = srv.ab
         put = srv.ctx.put_replicated  # the staging rule, mesh.py
         self.owner = put(ab.owner)
-        self.slot = put(ab.slot)
+        # tiered storage: the step indexes the DEVICE hot pool, so the
+        # slot mirror carries hot-pool ROWS (composed against the
+        # residency map, cached per epoch at the TierManager and shared
+        # by all runners; OOB while cold — fill zeros / drop, never the
+        # negative-index WRAP — and runners pin their batches hot so
+        # the step never actually touches a cold row)
+        self.slot = put(ab.slot if srv.tier is None
+                        else srv.tier.compose_slot_table())
         self.cache_row = put(ab.cache_slot[self.shard])
-        self._version = srv.topology_version
+        self._version = ver
 
     def tables(self):
         self.refresh()
@@ -659,7 +690,9 @@ class DeviceRoutedRunner:
         tail carries the dtype max so the alias path's searchsorted snap
         stays within the valid prefix."""
         srv = self.server
-        if self._li_version == srv.topology_version and \
+        li_ver = (srv.topology_version,
+                  srv.tier.epoch if srv.tier is not None else -1)
+        if self._li_version == li_ver and \
                 self._local_index is not None:
             return self._local_index
         ab = srv.ab
@@ -669,20 +702,62 @@ class DeviceRoutedRunner:
         from ..core.store import bucket_size
         local = (ab.owner[pop] == self.shard) | (
             ab.cache_slot[self.shard, pop] != NO_SLOT)
+        if srv.tier is not None:
+            # tiered storage: device-drawn negatives read/scatter main
+            # rows in-program, which only works for DEVICE-RESIDENT
+            # rows — restrict the draw population to hot-owned or
+            # replicated keys (a residency change invalidates the index
+            # via the epoch in li_ver). Sampling from the hot slice is
+            # a valid negative draw; cold keys rejoin the population as
+            # the promotion worker brings them up.
+            cid = self.role_class[self.neg_role]
+            res = srv.stores[cid].res
+            o_sh, o_sl = ab.owner[pop], ab.slot[pop]
+            owner_hot = np.zeros(len(pop), dtype=bool)
+            m = (o_sh == self.shard) & (o_sl >= 0)
+            if m.any():
+                owner_hot[m] = res.dev_row[o_sh[m], o_sl[m]] >= 0
+            local = owner_hot | (
+                ab.cache_slot[self.shard, pop] != NO_SLOT)
         idx = pop[local]
         # fallback flag feeds _mark_neg_writes: full-population draws can
         # scatter into OTHER shards' main rows, so write tracking must
         # widen beyond this shard
         self._li_fallback = len(idx) == 0
         if len(idx) == 0:
-            idx = pop  # nothing local: draw from the full population
+            if srv.tier is not None:
+                # tiered: the untiered fallback (draw from the FULL
+                # population) would sample cold keys, whose mirror rows
+                # are OOB — reads would silently return zeros and
+                # scatters drop. Promote a bounded slice of the
+                # population (wherever its rows are owned) and draw
+                # from the device-resident subset; fail loudly if even
+                # that cannot produce one resident key.
+                cid = self.role_class[self.neg_role]
+                res = srv.stores[cid].res
+                take = pop[: 4096]
+                srv.tier.ensure_hot(cid, ab.owner[take], ab.slot[take])
+                o_sh, o_sl = ab.owner[pop], ab.slot[pop]
+                ok = o_sl >= 0
+                resident = np.zeros(len(pop), dtype=bool)
+                resident[ok] = res.dev_row[o_sh[ok], o_sl[ok]] >= 0
+                idx = pop[resident]
+                if len(idx) == 0:
+                    raise RuntimeError(
+                        "tiered negative sampling: no device-resident "
+                        "key in the population and promotion could not "
+                        "produce one (hot pool full of pinned rows?) — "
+                        "raise --sys.tier.hot_rows or signal intent on "
+                        "the sampling population")
+            else:
+                idx = pop  # nothing local: draw from the full population
         cap = bucket_size(len(idx), minimum=64)
         kdt = _key_dtype(srv.num_keys)
         padded = np.full(cap, np.iinfo(kdt).max, dtype=kdt)
         padded[: len(idx)] = idx
         self._local_index = (srv.ctx.put_replicated(padded),
                              jnp.int32(len(idx)))
-        self._li_version = srv.topology_version
+        self._li_version = li_ver
         return self._local_index
 
     def _check_batch(self, role_keys: Dict[str, np.ndarray]) -> None:
@@ -718,6 +793,12 @@ class DeviceRoutedRunner:
                 "staged keys differ from the step's batch — pass the "
                 "handle prefetch_keys returned for THIS batch")
         with srv._lock:
+            if srv.tier is not None:
+                # tiered storage: the step reads main rows through the
+                # hot pool — promote + pin the batch before the route
+                # mirror is composed (ensure_hot bumps the residency
+                # epoch, which router.tables() below picks up)
+                srv.tier.pin_step_keys(self.role_class, role_keys)
             self._note_step_writes(role_keys)
             tables = self.router.tables()
             local_index = self._local_neg_index() \
@@ -771,6 +852,16 @@ class DeviceRoutedRunner:
         if has_aux:
             assert len(auxes) == K, "one aux per batch"
         with srv._lock:
+            if srv.tier is not None:
+                # placement AND residency freeze for the scan window:
+                # the route mirror is read ONCE for all K batches, so
+                # the whole window's rows must be hot simultaneously —
+                # pin the UNION (per-batch pinning would let a later
+                # batch's forced eviction victimize an earlier one)
+                union = {r: np.concatenate(
+                    [np.asarray(b[r], dtype=np.int64).ravel()
+                     for b in batches]) for r in batches[0]}
+                srv.tier.pin_step_keys(self.role_class, union)
             for b in batches:
                 self._note_step_writes(b)
             tables = self.router.tables()
@@ -846,6 +937,19 @@ class FusedStepRunner:
                 srv._prefetch_note(np.concatenate(
                     [np.asarray(k, dtype=np.int64).ravel()
                      for k in role_keys.values()]))
+            if srv.tier is not None:
+                # pin the whole batch's rows hot as ONE union before any
+                # role's routes are translated: build_routes resolves
+                # slot->hot-row per role, and a later role's forced
+                # eviction must never invalidate an earlier role's
+                # already-translated rows. Localize process-remote keys
+                # FIRST — pin_step_keys skips slot<0 entries, so a key
+                # localized later (inside build_routes) would fall
+                # outside the union's eviction protection
+                for r, k in role_keys.items():
+                    srv.ensure_local(np.asarray(k, dtype=np.int64)
+                                     .ravel(), shard)
+                srv.tier.pin_step_keys(self.role_class, role_keys)
             routes = self.routes_for(role_keys, shard)
             # mark the stores' dirty-delta tracking AFTER routes_for:
             # its ensure_local may localize keys, and the marking must

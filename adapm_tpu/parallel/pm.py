@@ -563,6 +563,16 @@ class GlobalPM:
                     with srv._topology_mutation():
                         self.reloc[rel_keys] = ctr[rel_mask]
                         for cid, cpos in srv._group_by_class(rel_keys):
+                            if srv.tier is not None:
+                                # release the abandoned slots' residency
+                                # (hot rows freed without copy-back: the
+                                # authoritative values were read into
+                                # `out` above) BEFORE the slots return
+                                # to the allocator
+                                from ..tier.promote import release_rows
+                                ks = rel_keys[cpos]
+                                release_rows(srv.stores[cid],
+                                             ab.owner[ks], ab.slot[ks])
                             ab.abandon_batch(rel_keys[cpos])
                         self.owner_hint[rel_keys] = req
                         self.interest[rel_keys] = 0

@@ -132,6 +132,13 @@ class LookupBatcher:
         else:
             allk = np.concatenate([r.keys for r in reqs])
         union = np.unique(allk)
+        if srv.tier is not None:
+            # tiered storage: consult residency before planning — bump
+            # the union keys' access scores and queue promotion of the
+            # cold ones, so the device-hot set adapts to serve load (the
+            # gather itself serves cold rows correctly through the cold
+            # path either way; tier.serve_cold_keys counts them)
+            srv.tier.note_serve(union)
         after = tuple(f for r in reqs for f in r.after)
         try:
             flat = self._lookup_union(union, after)

@@ -1,0 +1,20 @@
+"""Tiered parameter storage (ISSUE 5 tentpole): device-hot / host-cold
+main-row residency with intent-driven promotion.
+
+    residency.py — per-row tier + clock/frequency score fused with
+                   intent liveness; the TierManager coordinator
+    promote.py   — batched promotion/demotion programs + the
+                   maintenance (demotion) worker
+    coldpath.py  — the correct-but-slow cold path: tier-aware store
+                   operations (host gather → staged upload → merge)
+
+Enable with --sys.tier (plus --sys.tier.{hot_rows,pin_intent,
+demote_batch}); docs/MEMORY.md is the design doc. Every Pull/Push/serve
+lookup on the tiered store is bit-identical to the untiered store —
+residency moves values, never changes them.
+"""
+from __future__ import annotations
+
+from .promote import (PromotionEngine, demote_rows, ensure_hot_rows,  # noqa: F401
+                      promote_rows, release_rows)
+from .residency import Residency, TierManager  # noqa: F401

@@ -89,7 +89,11 @@ def save_server(server, path: str) -> None:
             arrs["reloc"] = server.glob.reloc
             arrs["interest"] = server.glob.interest
         for cid, st in enumerate(server.stores):
-            arrs[f"main_{cid}"] = np.asarray(st.main)
+            # main_host() is the authoritative full-size main table
+            # whether or not the store is tiered (cold store overlaid
+            # with the hot pool), so checkpoints restore across tier
+            # configurations — residency is transient state, not saved
+            arrs[f"main_{cid}"] = st.main_host()
             arrs[f"cache_{cid}"] = np.asarray(st.cache)
             arrs[f"delta_{cid}"] = np.asarray(st.delta)
     if server.glob is None:
@@ -160,10 +164,27 @@ def restore_server(server, path: str) -> None:
             sh = st.ctx.shard0()
             for name in ("main", "cache", "delta"):
                 arr = ck[f"{name}_{cid}"]
-                cur = getattr(st, name)
-                assert arr.shape == cur.shape, (
-                    f"pool {name}_{cid} geometry mismatch: checkpoint "
-                    f"{arr.shape} vs server {cur.shape}")
+                if name == "main":
+                    # checkpoints carry the authoritative FULL main
+                    # table (save_server main_host()); geometry is
+                    # tier-independent
+                    assert arr.shape == st.main_shape_full, (
+                        f"pool main_{cid} geometry mismatch: checkpoint "
+                        f"{arr.shape} vs server {st.main_shape_full}")
+                    if st.res is not None:
+                        # tiered restore: the table becomes the cold
+                        # store and residency resets — everything cold,
+                        # re-promoted lazily on access/intent (the
+                        # device hot pool's stale rows are unmapped and
+                        # never read)
+                        from ..tier.coldpath import install_main_full
+                        install_main_full(st, arr)
+                        continue
+                else:
+                    cur = getattr(st, name)
+                    assert arr.shape == cur.shape, (
+                        f"pool {name}_{cid} geometry mismatch: "
+                        f"checkpoint {arr.shape} vs server {cur.shape}")
                 new = jax.device_put(arr, sh)
                 # route the restored pool through an XLA program before
                 # it re-enters the donated-buffer chain: this image's

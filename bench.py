@@ -463,6 +463,96 @@ def bench_serve(E=20_000, vlen=32, clients=32, lookups_per_client=40,
     return out
 
 
+def bench_tier(E=40_000, d=32, B=1024, steps=60, warmup=20,
+               skew=16.0):
+    """Tiered-storage phase (ISSUE 5): pull/push throughput of the
+    skewed KGE-shaped workload (rows = [emb | adagrad], power-law key
+    skew) at device-hot capacity in {100%, 50%, 25%} of the keys vs the
+    untiered baseline. One fixed batch schedule is shared by every
+    configuration; adaptation (score-driven promotion) runs during
+    warmup via tier.maintain() and stays live (the maintenance worker)
+    during the timed window. The artifact records per-config hot-hit
+    rate and the cold-serve latency histogram P50/P99 alongside the
+    throughput ratios — the acceptance floor is hot-50% >= 0.8x
+    untiered."""
+    import adapm_tpu
+    import jax
+    from adapm_tpu.config import SystemOptions
+    from adapm_tpu.obs.metrics import hist_percentile
+
+    L = 2 * d
+    S = len(jax.devices())
+    rng = np.random.default_rng(0)
+    # zipf-ish schedule: key = E * u^skew -> P(top 25%) = 0.25^(1/skew)
+    sched = [(E * rng.random(B) ** skew).astype(np.int64).clip(0, E - 1)
+             for _ in range(warmup + steps)]
+    init = np.random.default_rng(1).normal(
+        size=(E, L)).astype(np.float32)
+    upd = (np.random.default_rng(2).normal(
+        size=(B, L)).astype(np.float32) * 1e-3)
+
+    def run_config(hot_frac):
+        tier = hot_frac is not None
+        hot_rows = max(8, -(-int(E * hot_frac) // S)) if tier else 0
+        srv = adapm_tpu.setup(E, L, opts=SystemOptions(
+            sync_max_per_sec=0, prefetch=False,
+            tier=tier, tier_hot_rows=hot_rows))
+        w = srv.make_worker(0)
+        slab = 50_000
+        for lo in range(0, E, slab):
+            hi = min(lo + slab, E)
+            w.set(np.arange(lo, hi), init[lo:hi])
+        for b in sched[:warmup]:
+            w.pull_sync(b)
+            w.push(b, upd)
+            if tier:
+                srv.tier.maintain()
+        srv.block()
+        h0 = c0 = 0
+        if tier:
+            st = srv.stores[0]
+            h0, c0 = st.tier_hot_hits, st.tier_cold_hits
+        t0 = time.perf_counter()
+        for b in sched[warmup:]:
+            w.pull_sync(b)
+            w.push(b, upd)
+        srv.block()
+        dt = time.perf_counter() - t0
+        out = {"keys_per_sec": round(2 * steps * B / dt, 1)}
+        if tier:
+            st = srv.stores[0]
+            dh = st.tier_hot_hits - h0
+            dc = st.tier_cold_hits - c0
+            out["hot_hit_rate"] = round(dh / max(1, dh + dc), 4)
+            out["hot_rows_per_shard"] = hot_rows
+            cold = srv.obs.find("tier.cold_serve_s")
+            snap = cold.snap() if cold is not None else 0
+            if snap and snap.get("count"):
+                out["cold_serve_p50_ms"] = round(
+                    1e3 * hist_percentile(snap, 0.50), 3)
+                out["cold_serve_p99_ms"] = round(
+                    1e3 * hist_percentile(snap, 0.99), 3)
+            # the tier metrics snapshot rides in the artifact
+            out["tier_metrics"] = srv.metrics_snapshot()["tier"]
+        srv.shutdown()
+        return out
+
+    _progress(f"tier phase: untiered baseline ({E} keys, B={B})")
+    base = run_config(None)
+    res = {"keys_per_lookup": B,
+           "untiered_keys_per_sec": base["keys_per_sec"],
+           "tier": {}}
+    for frac in (1.0, 0.5, 0.25):
+        _progress(f"tier phase: hot capacity {int(frac * 100)}%")
+        res["tier"][f"hot_{int(frac * 100)}pct"] = run_config(frac)
+    r50 = res["tier"]["hot_50pct"]["keys_per_sec"] / \
+        max(1e-9, base["keys_per_sec"])
+    res["ratio_50pct_vs_untiered"] = round(r50, 3)
+    _progress(f"tier phase: hot-50% ratio {r50:.3f} "
+              f"(hit rate {res['tier']['hot_50pct'].get('hot_hit_rate')})")
+    return res
+
+
 def bench_w2v(V=100_000, d=128, B=8192, N=5, steps=40, warmup=4,
               scan_steps=1) -> float:
     """word2vec SGNS fused-step throughput (pairs/sec) with on-device
@@ -700,6 +790,17 @@ def _phase_serve():
     return out
 
 
+def _phase_tier():
+    import jax
+    sz = {"E": 10_000, "B": 512, "steps": 30, "warmup": 12} \
+        if os.environ.get("ADAPM_BENCH_SMALL") else {}
+    out = bench_tier(**sz)
+    out["virtual_shards"] = len(jax.devices("cpu"))
+    if sz:
+        out["small_sizes"] = sz
+    return out
+
+
 def _phase_w2v():
     if os.environ.get("ADAPM_BENCH_SMALL"):
         small = dict(V=20_000, d=64, B=2048, warmup=2)
@@ -729,13 +830,14 @@ def _phase_cpu():
 _PHASES = {"probe": _phase_probe, "kge": _phase_kge,
            "prefetch": _phase_prefetch, "scan": _phase_scan,
            "dedup": _phase_dedup, "pm": _phase_pm, "mgmt": _phase_mgmt,
-           "serve": _phase_serve, "w2v": _phase_w2v, "cpu": _phase_cpu}
+           "serve": _phase_serve, "tier": _phase_tier, "w2v": _phase_w2v,
+           "cpu": _phase_cpu}
 
 # generous per-phase walls: a healthy phase finishes in a fraction of
 # these; a wedged relay burns one wall once, then the driver degrades
 _TIMEOUTS = {"probe": 120, "kge": 1200, "prefetch": 1200, "scan": 900,
              "dedup": 900, "pm": 900, "mgmt": 900, "serve": 900,
-             "w2v": 900, "cpu": 600}
+             "tier": 900, "w2v": 900, "cpu": 600}
 
 _CPU_ENV = {"JAX_PLATFORMS": "cpu", "ADAPM_PLATFORM": "cpu",
             "ADAPM_BENCH_SMALL": "1"}
@@ -846,6 +948,10 @@ def main():
     # and admission queue are host-side, and the comparison against
     # sequential per-request pulls needs both paths on the same backend
     results["serve"] = _run_phase("serve", pm_env)
+    # tiered-storage phase (ISSUE 5): host-CPU by design — the
+    # untiered-vs-tiered comparison needs both configurations on the
+    # same backend, and the cold path's cost is host<->device traffic
+    results["tier"] = _run_phase("tier", pm_env)
     results["cpu"] = _run_phase("cpu")
 
     def phase_val(name, field):
@@ -910,6 +1016,8 @@ def main():
                  else {"error": "mgmt failed"}),
         "serve": (results["serve"] if _ok(results["serve"])
                   else {"error": "serve failed"}),
+        "tier": (results["tier"] if _ok(results["tier"])
+                 else {"error": "tier failed"}),
         "w2v_pairs_per_sec": round(w2v, 1),
         "dedup": {"unique_batch_triples_per_sec": round(tput_unique, 1),
                   "gain_vs_skewed":

@@ -24,5 +24,10 @@ python scripts/mgmt_plane_check.py
 # clients must beat sequential per-request pulls, and an idle serve
 # loop must dispatch zero device programs
 python scripts/serve_latency_check.py
+# tiered-storage guard (ISSUE 5): under a zipf workload at 25% hot
+# capacity the promotion policy must reach >= 0.9 hot-hit rate, the
+# all-cold configuration must read bit-identically to untiered, and
+# the all-hot tiered pull path must stay near parity with untiered
+python scripts/tier_residency_check.py
 python -m pytest tests/ -q "$@"
 echo "ALL TESTS PASSED"

@@ -44,3 +44,59 @@ import pytest  # noqa: E402
 @pytest.fixture
 def rng():
     return np.random.default_rng(0)
+
+
+# ---------------------------------------------------------------------------
+# Isolate-and-retry for this image's known intermittent XLA-CPU abort
+# (CHANGES.md r6 note): test_checkpoint.py::test_roundtrip_exact
+# segfaults/aborts ~1/2 of isolated module runs ON THE UNMODIFIED SEED
+# (an environment bug needing broader session state, not a code bug; the
+# r6 restore-launder reduced but did not eliminate it). An in-process
+# abort would take the WHOLE pytest session down, flickering the tier-1
+# signal — so the affected test runs in a subprocess, and a CRASH
+# (signal exit) retries exactly once with a loud log line. A normal
+# assertion failure is reported immediately, never retried.
+# ---------------------------------------------------------------------------
+
+_ISOLATE_RETRY_NODEIDS = {
+    "tests/test_checkpoint.py::test_roundtrip_exact",
+}
+
+_CRASH_RCS = {132, 133, 134, 135, 136, 137, 138, 139}  # 128 + SIG*
+
+
+def _run_isolated(nodeid: str) -> None:
+    import subprocess
+    import sys as _s
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, ADAPM_ISOLATED="1")
+    cmd = [_s.executable, "-m", "pytest", "-q", "-p", "no:cacheprovider",
+           nodeid]
+    for attempt in (1, 2):
+        p = subprocess.run(cmd, env=env, cwd=root, capture_output=True,
+                           text=True, timeout=600)
+        if p.returncode == 0:
+            return
+        crashed = p.returncode < 0 or p.returncode in _CRASH_RCS
+        if crashed and attempt == 1:
+            _s.stderr.write(
+                f"\n[conftest] ISOLATED TEST CRASHED (rc={p.returncode}) "
+                f"— known image-level XLA-CPU abort (CHANGES.md r6); "
+                f"retrying once: {nodeid}\n")
+            _s.stderr.flush()
+            continue
+        tail = "\n".join((p.stdout + p.stderr).splitlines()[-30:])
+        kind = "crashed twice (rc=%d)" % p.returncode if crashed \
+            else "failed (rc=%d)" % p.returncode
+        pytest.fail(f"isolated run of {nodeid} {kind}:\n{tail}",
+                    pytrace=False)
+
+
+def pytest_collection_modifyitems(config, items):
+    if os.environ.get("ADAPM_ISOLATED"):
+        return  # inside the isolated subprocess: run normally
+    for item in items:
+        if item.nodeid in _ISOLATE_RETRY_NODEIDS:
+            item.runtest = (lambda nid=item.nodeid:
+                            _run_isolated(nid))

@@ -172,6 +172,33 @@ def test_serve_knobs_rejected_at_parse_time():
         SystemOptions(serve_max_batch=-3).validate_serve()
 
 
+def test_tier_knobs_round_trip_and_rejection():
+    """--sys.tier.* parse into the options the TierManager consumes,
+    and bad ranges fail loudly at parse time (ISSUE 5)."""
+    import argparse
+
+    import pytest
+
+    from adapm_tpu.config import SystemOptions
+    p = argparse.ArgumentParser()
+    SystemOptions.add_arguments(p)
+    dflt = SystemOptions.from_args(p.parse_args([]))
+    assert (dflt.tier, dflt.tier_hot_rows, dflt.tier_pin_intent,
+            dflt.tier_demote_batch) == (False, 65536, True, 1024)
+    on = SystemOptions.from_args(p.parse_args(
+        ["--sys.tier", "1", "--sys.tier.hot_rows", "4096",
+         "--sys.tier.pin_intent", "0", "--sys.tier.demote_batch",
+         "128"]))
+    assert on.tier and on.tier_hot_rows == 4096
+    assert not on.tier_pin_intent and on.tier_demote_batch == 128
+    for argv in (["--sys.tier", "1", "--sys.tier.hot_rows", "4"],
+                 ["--sys.tier", "1", "--sys.tier.demote_batch", "0"]):
+        with pytest.raises(ValueError):
+            SystemOptions.from_args(p.parse_args(argv))
+    # tier off: hot_rows range is irrelevant and must not reject
+    SystemOptions.from_args(p.parse_args(["--sys.tier.hot_rows", "4"]))
+
+
 def test_collective_sync_knobs():
     """--sys.collective_sync / --sys.collective_bucket parse into the
     options GlobalPM consults when choosing the sync data plane."""

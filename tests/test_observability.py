@@ -199,9 +199,12 @@ def test_metrics_snapshot_schema_stable():
     snap = srv.metrics_snapshot()
     # the documented schema contract (docs/OBSERVABILITY.md); v3 = the
     # PR 4 serve section (the online serving plane's metrics +
-    # readiness; {} until a ServePlane is attached)
-    assert snap["schema_version"] == 3 and snap["metrics_enabled"]
+    # readiness; {} until a ServePlane is attached); v4 = the PR 5 tier
+    # section (tiered-storage hot-hit/promotion metrics; {} while
+    # --sys.tier is off)
+    assert snap["schema_version"] == 4 and snap["metrics_enabled"]
     assert snap["serve"] == {}  # no ServePlane on this server
+    assert snap["tier"] == {}   # --sys.tier off on this server
     for sec in srv._SNAPSHOT_SECTIONS:
         assert isinstance(snap[sec], dict), sec
     # v2 sync surface: shipped vs considered + table-occupancy gauges

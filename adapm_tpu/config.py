@@ -120,6 +120,22 @@ class SystemOptions:
     # victim readback on the caller's path)
     tier_demote_batch: int = 1024
 
+    # -- unified async executor (sys.exec.*; adapm_tpu/exec,
+    #    docs/EXECUTOR.md): the one ordered-stream dispatch plane under
+    #    sync rounds, prefetch staging, tier maintenance, serve
+    #    batching, and fused steps. Worker-pool width bounds how many
+    #    streams make progress concurrently (background subsystems
+    #    share it; the training thread dispatches inline).
+    exec_workers: int = 4
+    # serialized fallback: one worker thread, so background programs
+    # execute strictly one at a time (oldest submission first) with
+    # zero cross-stream overlap; streams keep their identity, so
+    # per-subsystem drains and delayed programs still behave. The
+    # baseline the bench `exec` phase and scripts/exec_overlap_check.py
+    # compare the overlapped default against, and the conservative
+    # escape hatch.
+    exec_single_stream: bool = False
+
     # -- store geometry
     cache_slots_per_shard: int = 0   # 0 = auto (num_keys // num_shards)
     remote_bucket_min: int = 8       # min padded size of the remote op bucket
@@ -209,6 +225,11 @@ class SystemOptions:
             raise ValueError(
                 f"--sys.tier.demote_batch must be >= 1 "
                 f"(got {self.tier_demote_batch})")
+        if self.exec_workers < 1:
+            raise ValueError(
+                f"--sys.exec.workers must be >= 1 "
+                f"(got {self.exec_workers}): the executor's streams "
+                f"need at least one worker to make progress")
         if self.serve_queue < self.serve_max_batch:
             raise ValueError(
                 f"inconsistent serve knobs: --sys.serve.queue "
@@ -272,6 +293,11 @@ class SystemOptions:
         g.add_argument("--sys.tier.demote_batch",
                        dest="sys_tier_demote_batch", type=int,
                        default=1024)
+        g.add_argument("--sys.exec.workers", dest="sys_exec_workers",
+                       type=int, default=4)
+        g.add_argument("--sys.exec.single_stream",
+                       dest="sys_exec_single_stream", type=int,
+                       default=0)
         g.add_argument("--sys.stats.out", dest="sys_stats_out", default=None)
         g.add_argument("--sys.trace.keys", dest="sys_trace_keys", default=None)
         g.add_argument("--sys.stats.locality", dest="sys_stats_locality",
@@ -339,6 +365,8 @@ class SystemOptions:
             tier_hot_rows=args.sys_tier_hot_rows,
             tier_pin_intent=bool(args.sys_tier_pin_intent),
             tier_demote_batch=args.sys_tier_demote_batch,
+            exec_workers=args.sys_exec_workers,
+            exec_single_stream=bool(args.sys_exec_single_stream),
             stats_out=args.sys_stats_out,
             trace_keys=args.sys_trace_keys,
             locality_stats=args.sys_stats_locality,

@@ -256,6 +256,16 @@ class PrefetchScheduler:
     gathered before an overlapping write (read-your-writes), and a
     staged hit is bit-identical to the pull it replaced.
 
+    PR 6: the dedicated pipeline thread is subsumed by the unified
+    executor (adapm_tpu/exec) — staging/round work runs as coalesced,
+    self-rescheduling programs on the `prefetch` stream, so prefetch
+    staging shares the executor worker pool and overlaps fused compute
+    dispatched from the `main` stream (the GraphVite-style episodic
+    overlap the `exec.overlap_fraction` gauge measures). Work arrives
+    via kicks (on_intent / pump / note_writes restage); an idle
+    pipeline owns no queued program, and only deferred (out-of-window)
+    intents keep a delayed poll program alive.
+
     Pull staging is gated by `opts.prefetch_pull`: "auto" stages only
     for workers that actually use the Pull API (fused-runner loops never
     pull, and staging gathers for them would be wasted device work),
@@ -268,7 +278,6 @@ class PrefetchScheduler:
         self.server = server
         self.opts = opts
         self._cond = threading.Condition()
-        self._thread: Optional[threading.Thread] = None
         self._stop = False
         self._busy = False
         self._rounds = 0            # delegated planner rounds (capped)
@@ -443,12 +452,17 @@ class PrefetchScheduler:
                         raise TimeoutError("prefetch pipeline flush")
 
     def close(self) -> None:
+        """Idempotent: stop accepting work, drain in-flight passes off
+        the `prefetch` stream (a queued pass observes `_stop` and
+        returns immediately), release every staged buffer."""
         with self._cond:
             self._stop = True
             self._cond.notify_all()
-        t = self._thread
-        if t is not None:
-            t.join(timeout=30)
+        ex = self.server.exec
+        if not ex.closed and not ex.drain("prefetch", timeout=30):
+            from ..utils import alog
+            alog("[prefetch] pipeline failed to drain within 30s of "
+                 "close — a staging pass is wedged mid-dispatch")
         self.invalidate_all()
 
     # -- internals -----------------------------------------------------------
@@ -462,10 +476,15 @@ class PrefetchScheduler:
         return mode == "always" or worker.stats["pull_ops"] > 0
 
     def _kick_locked(self) -> None:
-        if self._thread is None and not self._stop:
-            self._thread = threading.Thread(
-                target=self._loop, daemon=True, name="adapm-prefetch")
-            self._thread.start()
+        """Queue one pipeline pass on the `prefetch` stream (caller
+        holds _cond; the executor lock is a leaf, so submitting under it
+        is safe). Coalesced: kicks landing while a pass is already
+        queued are absorbed — the pass swaps out the WHOLE backlog when
+        it runs. A kick during a RUNNING pass queues the next one."""
+        if not self._stop:
+            self.server.exec.submit("prefetch", self._pass,
+                                    label="prefetch.pass",
+                                    coalesce_key="prefetch.pass")
         self._cond.notify_all()
 
     def _mask_add(self, keys: np.ndarray) -> None:
@@ -482,67 +501,71 @@ class PrefetchScheduler:
             pool.release(rows)
         e.acquired = []
 
-    def _loop(self) -> None:
+    def _pass(self) -> None:
+        """One pipeline pass (an executor program on the `prefetch`
+        stream): swap out the whole backlog under _cond, process it
+        lock-free, then reschedule only if deferred intents need the
+        0.25 s window poll (a fully idle pipeline owns no queued
+        program — the executor worker parks on its condvar)."""
         from ..utils import alog
         srv = self.server
-        while True:
+        with self._cond:
+            if self._stop:
+                self._cond.notify_all()
+                return
+            self._busy = True
+            self._sweep = False
+            rounds, self._rounds = self._rounds, 0
+            pending, self._pending = self._pending, []
+            restage, self._restage = self._restage, []
+        try:
+            for _ in range(rounds):
+                srv.sync.run_round()
+                self.stats.inc("rounds_driven")
+            if rounds:
+                self._refresh_consumers()
+            self._expire()
+            from ..base import WORKER_FINISHED
+            now_deferred = []
+            for item in self._deferred + pending:
+                w, keys, start, end = item
+                # a finalized worker never pulls again — its parked
+                # intents (even CLOCK_MAX ones) must not keep the
+                # deferred poll alive
+                if end < w.current_clock or \
+                        w.current_clock == WORKER_FINISHED:
+                    self.stats.inc("expired")
+                    continue
+                window = int(srv.sync.timer.window()[w.worker_id])
+                if start > w.current_clock + window:
+                    now_deferred.append(item)
+                    continue
+                self._stage_one(w, keys, end)
+            self._deferred = now_deferred
+            for w, keys, _, end in restage:
+                if end >= w.current_clock:
+                    # record=False: the original staging already
+                    # counted this batch in the locality stats; a
+                    # write-invalidation restage must not count the
+                    # same eventual pull twice
+                    if self._stage_one(w, keys, end, record=False):
+                        self.stats.inc("restaged")
+        except Exception as e:  # noqa: BLE001 — keep the pipeline up
+            alog(f"[prefetch] background task failed: "
+                 f"{type(e).__name__}: {e}")
+        finally:
             with self._cond:
-                while not (self._stop or self._rounds or self._pending
-                           or self._restage or self._sweep):
-                    self._busy = False
-                    self._cond.notify_all()
-                    # finite wait only while deferred intents may enter
-                    # the window as clocks advance (coarse: a deferred
-                    # intent is by definition not imminent, and an idle
-                    # server with a parked far-future intent should not
-                    # be woken 20x a second)
-                    self._cond.wait(0.25 if self._deferred else None)
-                    if self._deferred:
-                        break
-                if self._stop:
-                    self._busy = False
-                    self._cond.notify_all()
-                    return
-                self._busy = True
-                self._sweep = False
-                rounds, self._rounds = self._rounds, 0
-                pending, self._pending = self._pending, []
-                restage, self._restage = self._restage, []
-            try:
-                for _ in range(rounds):
-                    srv.sync.run_round()
-                    self.stats.inc("rounds_driven")
-                if rounds:
-                    self._refresh_consumers()
-                self._expire()
-                from ..base import WORKER_FINISHED
-                now_deferred = []
-                for item in self._deferred + pending:
-                    w, keys, start, end = item
-                    # a finalized worker never pulls again — its parked
-                    # intents (even CLOCK_MAX ones) must not keep the
-                    # deferred poll alive
-                    if end < w.current_clock or \
-                            w.current_clock == WORKER_FINISHED:
-                        self.stats.inc("expired")
-                        continue
-                    window = int(srv.sync.timer.window()[w.worker_id])
-                    if start > w.current_clock + window:
-                        now_deferred.append(item)
-                        continue
-                    self._stage_one(w, keys, end)
-                self._deferred = now_deferred
-                for w, keys, _, end in restage:
-                    if end >= w.current_clock:
-                        # record=False: the original staging already
-                        # counted this batch in the locality stats; a
-                        # write-invalidation restage must not count the
-                        # same eventual pull twice
-                        if self._stage_one(w, keys, end, record=False):
-                            self.stats.inc("restaged")
-            except Exception as e:  # noqa: BLE001 — keep the pipeline up
-                alog(f"[prefetch] background task failed: "
-                     f"{type(e).__name__}: {e}")
+                self._busy = False
+                if self._deferred and not self._stop:
+                    # deferred intents enter the window as clocks
+                    # advance: keep a DELAYED poll queued (coalesces
+                    # with — and is tightened to "now" by — any real
+                    # kick that lands first)
+                    self.server.exec.submit("prefetch", self._pass,
+                                            label="prefetch.pass",
+                                            coalesce_key="prefetch.pass",
+                                            delay=0.25)
+                self._cond.notify_all()
 
     def _refresh_consumers(self) -> None:
         if not self._refreshers:

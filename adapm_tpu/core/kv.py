@@ -130,6 +130,17 @@ class Server:
             from ..obs.spans import SpanTracer
             self.spans = SpanTracer(rank=self.pid,
                                     breadcrumb_path=bc_path)
+        # unified async executor (ISSUE 6 tentpole; adapm_tpu/exec,
+        # docs/EXECUTOR.md): THE ordered-stream dispatch plane under
+        # sync rounds, prefetch staging, tier maintenance, serve
+        # batching, and fused steps. Built right after the registry so
+        # every subsystem below can submit from construction; closed
+        # LAST in shutdown(), after every producer is stopped.
+        from ..exec import AsyncExecutor
+        self.exec = AsyncExecutor(registry=self.obs,
+                                  workers=self.opts.exec_workers,
+                                  single_stream=self.opts.exec_single_stream)
+
         # kv-layer metrics: per-op latency histograms live on the
         # workers (kv.pull_s/push_s/set_s, shared); registry-side extras:
         self._c_topo_bumps = self.obs.counter("kv.topology_bumps")
@@ -1021,38 +1032,63 @@ class Server:
 
     def start_sync_thread(self) -> None:
         """Run sync rounds in the background (reference SyncManager threads,
-        coloc_kv_server.h:100-105). Optional: tests drive rounds manually."""
+        coloc_kv_server.h:100-105). Optional: tests drive rounds manually.
+
+        PR 6: the dedicated thread is subsumed by the executor — rounds
+        run as a self-rescheduling program on the `sync` stream (one
+        round per program, FIFO, resubmitted until stopped), so
+        background sync shares the executor's worker pool and shows up
+        in its queue/overlap accounting. `_sync_thread` remains the
+        started/stopped token the old API exposed (None = stopped)."""
         if self._sync_thread is not None:
             return
         self._sync_stop.clear()
+        state = {"last_report": _time.monotonic(), "last_rounds": 0}
+        token = object()
+        self._sync_thread = token
 
-        def loop():
-            import time as _time
+        def tick():
             from ..utils import alog
-            last_report = _time.monotonic()
-            last_rounds = 0
-            while not self._sync_stop.is_set():
-                with self._round_lock:
-                    self.sync.run_round()
-                # periodic report (reference SyncManager 10-second reports,
-                # sync_manager.h:482-497)
-                rs = self.opts.sync_report_s
-                now = _time.monotonic()
-                if rs > 0 and now - last_report >= rs:
-                    dr = self.sync.stats.rounds - last_rounds
-                    alog(f"[sync] {dr / (now - last_report):.1f} rounds/s | "
-                         + self.sync.report())
-                    last_report, last_rounds = now, self.sync.stats.rounds
+            if self._sync_stop.is_set() or self._sync_thread is not token:
+                return
+            with self._round_lock:
+                self.sync.run_round()
+            # periodic report (reference SyncManager 10-second reports,
+            # sync_manager.h:482-497)
+            rs = self.opts.sync_report_s
+            now = _time.monotonic()
+            if rs > 0 and now - state["last_report"] >= rs:
+                dr = self.sync.stats.rounds - state["last_rounds"]
+                alog(f"[sync] "
+                     f"{dr / (now - state['last_report']):.1f} rounds/s | "
+                     + self.sync.report())
+                state["last_report"] = now
+                state["last_rounds"] = self.sync.stats.rounds
+            if not self._sync_stop.is_set() and \
+                    self._sync_thread is token:
+                self.exec.submit("sync", tick, label="sync.round")
 
-        self._sync_thread = threading.Thread(target=loop, daemon=True,
-                                             name="adapm-sync")
-        self._sync_thread.start()
+        self.exec.submit("sync", tick, label="sync.round")
 
     def stop_sync_thread(self) -> None:
         if self._sync_thread is None:
             return
         self._sync_stop.set()
-        self._sync_thread.join()
+        # drain, not join: at most one more queued round observes the
+        # stop flag and returns immediately. A round that does NOT
+        # drain is wedged (e.g. blocked on a dead remote peer) and
+        # still reads through the pools — proceeding into executor
+        # close and pool teardown would be a use-after-teardown, so
+        # fail-stop loudly instead (the serve-dispatcher discipline,
+        # docs/failure_handling.md)
+        if not self.exec.drain("sync", timeout=60):
+            from ..utils import alog
+            alog("[sync] background round failed to drain within 60s "
+                 "of stop — wedged mid-round (dead remote peer?)")
+            raise RuntimeError(
+                "sync round wedged: did not drain within 60s of stop; "
+                "refusing to proceed into pool teardown under a live "
+                "reader")
         self._sync_thread = None
 
     def _wb_active_ids(self) -> set:
@@ -1188,14 +1224,17 @@ class Server:
         every closed plane reads through the pools the later steps block
         on, so readers go down strictly before their substrate:
 
-          1. serve plane (stop admitting lookups; dispatcher joins)
+          1. serve plane (stop admitting lookups; dispatcher drains)
           2. metrics reporter
           3. prefetch pipeline (staged gathers + delegated rounds)
           4. tier maintenance worker (demotion readbacks)
-          5. background sync thread
-          6. pool quiesce (block) + sync channel executor
-          7. stats / trace / span export, registry unhook
-          8. cross-process layer
+          5. background sync rounds
+          6. the unified executor (every producer above is stopped, so
+             a well-ordered close cancels nothing; queued stragglers
+             finish cancelled rather than dispatching into teardown)
+          7. pool quiesce (block) + sync channel executor
+          8. stats / trace / span export, registry unhook
+          9. cross-process layer
 
         Idempotent: a second shutdown() is a no-op (each subordinate
         close is idempotent too, so a test that closed a plane manually
@@ -1215,6 +1254,7 @@ class Server:
         if self.tier is not None:
             self.tier.close()
         self.stop_sync_thread()
+        self.exec.close()
         self.block()
         self.sync.close()
         self.write_stats()
@@ -1299,7 +1339,7 @@ class Server:
     # metrics_snapshot() — the schema-stability contract tests pin
     _SNAPSHOT_SECTIONS = ("kv", "prefetch", "plan_cache", "staging",
                           "sync", "pm", "collective", "fused", "spans",
-                          "serve", "tier")
+                          "serve", "tier", "exec")
 
     def metrics_snapshot(self, drain_device: bool = True) -> Dict:
         """One structured, JSON-serializable telemetry dict for this
@@ -1330,8 +1370,16 @@ class Server:
         schema_version 4 (PR 5): new `tier` section — the tiered-
         storage plane's hot-hit rate, promotions/demotions, hot-pool
         occupancy gauges, and the cold-serve latency histogram
-        (`tier.cold_serve_s`); `{}` when --sys.tier is off."""
-        out: Dict = {"schema_version": 4,
+        (`tier.cold_serve_s`); `{}` when --sys.tier is off.
+
+        schema_version 5 (PR 6): new always-present `exec` section —
+        the unified executor's per-stream queue-depth gauges
+        (`exec.queue_depth.<stream>`), the enqueue->dispatch latency
+        histogram (`exec.dispatch_wait_s`), program counters, and the
+        `exec.overlap_fraction` gauge (fraction of busy executor wall
+        time where >= 2 streams ran simultaneously — the
+        transfer/compute-overlap measure)."""
+        out: Dict = {"schema_version": 5,
                      "metrics_enabled": bool(self.obs.enabled)}
         for s in self._SNAPSHOT_SECTIONS:
             out[s] = {}
@@ -1376,6 +1424,9 @@ class Server:
                      for k, v in self.glob.coll.stats.items()})
         if self.spans is not None:
             out["spans"].update(self.spans.stats())
+        # executor occupancy/overlap summary rides with the registry's
+        # exec.* gauges (same numbers, one locked read)
+        out["exec"].update(self.exec.stats())
         if serve_ready is not None:
             # readiness detail rides with the serve.* gauges: dead peers
             # (Server.dead_nodes — detection-only), queue depth/bound,

@@ -30,7 +30,17 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..exec import dispatch_gate
 from ..parallel.mesh import MeshContext
+
+# THE sharded-dispatch gate (adapm_tpu/exec, docs/EXECUTOR.md): every
+# sharded program dispatched by a store funnels through this one
+# process-wide mutex, so programs land on every device of the set in a
+# single global order — two servers sharing one virtual device set can
+# no longer deadlock XLA-CPU's collective rendezvous by dispatching
+# from different lock domains (the retired r10 known limit). Reentrant
+# and held for the ENQUEUE only (JAX dispatch is asynchronous).
+_GATE = dispatch_gate()
 
 # Out-of-range slot index for padding / masked entries: dropped by scatters
 # (mode="drop"), zero-filled by gathers (mode="fill").
@@ -395,7 +405,8 @@ class ShardedStore:
         a = pad_bucket(n, (o_shard, 0), (o_slot, OOB), (c_shard, 0),
                        (c_slot, OOB), (use_cache, False),
                        minimum=self.bucket_min)
-        return _gather(self.main, self.cache, self.delta, *a)
+        with _GATE:
+            return _gather(self.main, self.cache, self.delta, *a)
 
     def stage_gather(self, o_shard, o_slot, c_shard, c_slot, use_cache,
                      pool: "StagingPool"):
@@ -425,13 +436,15 @@ class ShardedStore:
                              np.asarray(d_slot)[md]] = True
         if self.res is not None:
             from ..tier import coldpath
-            coldpath.scatter_add_tiered(self, o_shard, o_slot, d_shard,
-                                        d_slot, vals)
+            coldpath.scatter_add_tiered(self, o_shard, o_slot,
+                                        d_shard, d_slot, vals)
             return
         a = pad_bucket(n, (o_shard, 0), (o_slot, OOB), (d_shard, 0),
                        (d_slot, OOB), minimum=self.bucket_min)
         v = self._vals_bucket(vals, a[0].shape[0])
-        self.main, self.delta = _scatter_add(self.main, self.delta, *a, v)
+        with _GATE:
+            self.main, self.delta = _scatter_add(self.main, self.delta,
+                                                 *a, v)
 
     def set_rows(self, o_shard, o_slot, vals, c_shard, c_slot):
         n = len(o_shard)
@@ -456,8 +469,10 @@ class ShardedStore:
         a = pad_bucket(n, (o_shard, 0), (o_slot, OOB), (c_shard, 0),
                        (c_slot, OOB), minimum=self.bucket_min)
         v = self._vals_bucket(vals, a[0].shape[0])
-        self.main, self.cache, self.delta = _set_rows(
-            self.main, self.cache, self.delta, a[0], a[1], v, a[2], a[3])
+        with _GATE:
+            self.main, self.cache, self.delta = _set_rows(
+                self.main, self.cache, self.delta, a[0], a[1], v,
+                a[2], a[3])
 
     def replica_create(self, o_shard, o_slot, c_shard, c_slot):
         n = len(o_shard)
@@ -472,8 +487,9 @@ class ShardedStore:
             return
         a = pad_bucket(n, (o_shard, 0), (o_slot, OOB), (c_shard, 0),
                        (c_slot, OOB), minimum=self.bucket_min)
-        self.cache, self.delta = _replica_create(
-            self.main, self.cache, self.delta, *a)
+        with _GATE:
+            self.cache, self.delta = _replica_create(
+                self.main, self.cache, self.delta, *a)
 
     def sync_replicas(self, r_shard, r_cslot, o_shard, o_slot,
                       threshold: float = 0.0):
@@ -505,13 +521,15 @@ class ShardedStore:
             return
         a = pad_bucket(n, (r_shard, 0), (r_cslot, OOB), (o_shard, 0),
                        (o_slot, OOB), minimum=self.bucket_min)
-        if threshold > 0.0:
-            self.main, self.cache, self.delta = _sync_replicas_thresholded(
-                self.main, self.cache, self.delta, *a,
-                jnp.asarray(threshold, self.dtype))
-        else:
-            self.main, self.cache, self.delta = _sync_replicas(
-                self.main, self.cache, self.delta, *a)
+        with _GATE:
+            if threshold > 0.0:
+                self.main, self.cache, self.delta = \
+                    _sync_replicas_thresholded(
+                        self.main, self.cache, self.delta, *a,
+                        jnp.asarray(threshold, self.dtype))
+            else:
+                self.main, self.cache, self.delta = _sync_replicas(
+                    self.main, self.cache, self.delta, *a)
 
     def relocate_rows(self, old_shard, old_slot, new_shard, new_slot,
                       rc_shard, rc_slot):
@@ -535,7 +553,8 @@ class ShardedStore:
         a = pad_bucket(n, (old_shard, 0), (old_slot, OOB), (new_shard, 0),
                        (new_slot, OOB), (rc_shard, 0), (rc_slot, OOB),
                        minimum=self.bucket_min)
-        self.main, self.delta = _relocate(self.main, self.delta, *a)
+        with _GATE:
+            self.main, self.delta = _relocate(self.main, self.delta, *a)
 
     # -- cross-process helpers (parallel/pm.py GlobalPM) ---------------------
 
@@ -551,7 +570,9 @@ class ShardedStore:
         a = pad_bucket(n, (sh, 0), (sl, OOB), minimum=self.bucket_min)
         arr = {"main": self.main, "cache": self.cache,
                "delta": self.delta}[which]
-        return np.asarray(_read_rows_at(arr, *a))[:n]
+        with _GATE:
+            rows = _read_rows_at(arr, *a)
+        return np.asarray(rows)[:n]
 
     # -- tiered-residency helpers (adapm_tpu/tier; no-ops untiered) ----------
 
@@ -560,7 +581,9 @@ class ShardedStore:
         demotion/relocation readback; non-destructive)."""
         n = len(sh)
         a = pad_bucket(n, (sh, 0), (row, OOB), minimum=self.bucket_min)
-        return np.asarray(_read_rows_at(self.main, *a))[:n]
+        with _GATE:
+            rows = _read_rows_at(self.main, *a)
+        return np.asarray(rows)[:n]
 
     def main_host(self) -> np.ndarray:
         """The full authoritative main table [S, main_slots, L] on host
@@ -588,17 +611,19 @@ class ShardedStore:
         a = pad_bucket(n, (c_shard, 0), (c_slot, OOB),
                        minimum=self.bucket_min)
         v = self._vals_bucket(vals, a[0].shape[0])
-        self.cache, self.delta = _install_rows(self.cache, self.delta,
-                                               *a, v)
+        with _GATE:
+            self.cache, self.delta = _install_rows(self.cache,
+                                                   self.delta, *a, v)
 
     def refresh_after_sync(self, c_shard, c_slot, fresh, shipped) -> None:
         n = len(c_shard)
         a = pad_bucket(n, (c_shard, 0), (c_slot, OOB),
                        minimum=self.bucket_min)
         b = a[0].shape[0]
-        self.cache, self.delta = _refresh_after_sync(
-            self.cache, self.delta, *a,
-            self._vals_bucket(fresh, b), self._vals_bucket(shipped, b))
+        with _GATE:
+            self.cache, self.delta = _refresh_after_sync(
+                self.cache, self.delta, *a,
+                self._vals_bucket(fresh, b), self._vals_bucket(shipped, b))
 
     def block(self) -> None:
         jax.block_until_ready((self.main, self.cache, self.delta))

@@ -1,0 +1,35 @@
+"""Unified async executor (ISSUE 6 tentpole): ONE program-dispatch
+plane under all five subsystems.
+
+Before this package, five subsystems each owned a thread + lock bracket
+that enqueued device programs — sync rounds (core/sync.py), prefetch
+staging (core/intent.py), tier promotion/demotion (tier/promote.py),
+serve gathers (serve/batcher.py), and fused steps (ops/fused.py). The
+seams showed: two servers sharing one virtual device set could deadlock
+XLA-CPU's collective rendezvous because no single owner controlled
+enqueue order across lock domains (the r10 known limit).
+
+The executor provides (docs/EXECUTOR.md has the full contract):
+
+  - **ordered streams per resource** (`AsyncExecutor`): programs
+    submitted to one stream run FIFO, one at a time; distinct streams
+    interleave freely; dependencies are expressed as stream edges
+    (`after=` completions), never as a lock held across dispatch;
+  - **sharded-dispatch serialization** (`dispatch_gate`): every sharded
+    device-program dispatch in the process funnels through one gate —
+    the process-wide "collective stream" — so programs land on every
+    device of the set in ONE global order, eliminating the rendezvous
+    deadlock by construction;
+  - **overlap**: background host work (promotion batch prep, prefetch
+    staging, sync classification) runs on executor streams while device
+    programs dispatched from other streams are in flight, with an
+    `exec.overlap_fraction` gauge measuring the wall time where >= 2
+    streams were simultaneously busy.
+
+The lock-narrowing rule, stated once: **enqueue under the lock,
+dispatch never** — the server lock brackets table snapshots, coordinate
+revalidation, and stream/program ENQUEUE; the executor (and JAX's async
+dispatch under the gate) owns execution order.
+"""
+from .executor import (AsyncExecutor, Completion,  # noqa: F401
+                       dispatch_gate)

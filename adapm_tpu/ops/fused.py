@@ -50,6 +50,13 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.store import OOB
+from ..exec import dispatch_gate
+
+# sharded-dispatch serialization (adapm_tpu/exec, docs/EXECUTOR.md):
+# the fused step is a sharded program like every store op — its
+# dispatch funnels through the same process-wide gate so two servers
+# on one device set can never interleave per-device enqueue orders
+_GATE = dispatch_gate()
 
 
 def _key_dtype(num_keys: int):
@@ -814,11 +821,16 @@ class DeviceRoutedRunner:
             pools = tuple((s.main, s.cache, s.delta) for s in srv.stores)
             fn = self.step_fn if self._shard_has_replicas() \
                 else self._step_fn_norep
-            pools, self._locstat, loss = fn(
-                pools, self._locstat, tables, keys, local_index,
-                self._alias, sub, aux, self._scalar(lr), self._scalar(eps))
-            for st, (m, c, d) in zip(srv.stores, pools):
-                st.main, st.cache, st.delta = m, c, d
+            # dispatch under the gate, tracked on the "main" stream for
+            # the executor's overlap accounting (enqueue-only: the jit
+            # call returns as soon as the program is queued)
+            with srv.exec.track("main"), _GATE:
+                pools, self._locstat, loss = fn(
+                    pools, self._locstat, tables, keys, local_index,
+                    self._alias, sub, aux, self._scalar(lr),
+                    self._scalar(eps))
+                for st, (m, c, d) in zip(srv.stores, pools):
+                    st.main, st.cache, st.delta = m, c, d
             self.steps += 1
             self._ensure_drain_every(role_keys)
             if self.steps % self._drain_every == 0:
@@ -887,12 +899,13 @@ class DeviceRoutedRunner:
             pools = tuple((s.main, s.cache, s.delta) for s in srv.stores)
             fn = self._scan_fn(no_replicas=not self._shard_has_replicas(),
                                has_aux=has_aux)
-            pools, self._locstat, losses = fn(
-                pools, self._locstat, tables, keys, local_index,
-                self._alias, rngs, aux, self._scalar(lr),
-                self._scalar(eps))
-            for st, (m, c, d) in zip(srv.stores, pools):
-                st.main, st.cache, st.delta = m, c, d
+            with srv.exec.track("main"), _GATE:
+                pools, self._locstat, losses = fn(
+                    pools, self._locstat, tables, keys, local_index,
+                    self._alias, rngs, aux, self._scalar(lr),
+                    self._scalar(eps))
+                for st, (m, c, d) in zip(srv.stores, pools):
+                    st.main, st.cache, st.delta = m, c, d
             self.steps += K
             self._ensure_drain_every(batches[0])
             if self.steps // self._drain_every != \
@@ -957,9 +970,10 @@ class FusedStepRunner:
             _mark_fused_writes(srv, shard, self.role_class, role_keys,
                                skip_roles=self.frozen_roles)
             pools = tuple((s.main, s.cache, s.delta) for s in srv.stores)
-            pools, loss = self.step_fn(
-                pools, routes, aux, jnp.float32(lr), jnp.float32(eps))
-            for st, (m, c, d) in zip(srv.stores, pools):
-                st.main, st.cache, st.delta = m, c, d
+            with srv.exec.track("main"), _GATE:
+                pools, loss = self.step_fn(
+                    pools, routes, aux, jnp.float32(lr), jnp.float32(eps))
+                for st, (m, c, d) in zip(srv.stores, pools):
+                    st.main, st.cache, st.delta = m, c, d
         self.steps += 1
         return loss

@@ -140,6 +140,10 @@ class AdmissionQueue:
         self._q: "collections.deque[LookupRequest]" = collections.deque()
         self._cond = threading.Condition()
         self._closed = False
+        # dispatcher kick (PR 6): the LookupBatcher registers a callback
+        # that queues a drain program on the executor's `serve` stream —
+        # event-driven dispatch instead of a thread parked in take()
+        self._kick = None
         from ..obs.metrics import Counter
         if registry is not None and registry.enabled:
             self.c_rejected = registry.counter("serve.rejected_total",
@@ -186,8 +190,23 @@ class AdmissionQueue:
                     f"--sys.serve.queue")
             self._q.append(req)
             self._cond.notify()
+            kick = self._kick
+        if kick is not None:
+            # outside the queue lock: the kick enqueues an executor
+            # program (leaf lock), and a submit that loses the race with
+            # a running drain still queues the NEXT drain — no lost
+            # wakeup (the drain re-checks the queue before exiting
+            # either way, but the invariant is: every admitted request
+            # has a drain program submitted after it)
+            kick()
 
-    # -- consumer (the LookupBatcher dispatcher thread) ----------------------
+    def set_kick(self, fn) -> None:
+        """Register (or clear, fn=None) the dispatcher kick called after
+        every successful submit (PR 6 executor-driven dispatch)."""
+        with self._cond:
+            self._kick = fn
+
+    # -- consumer (the LookupBatcher drain program) --------------------------
 
     def _pop_live_locked(self) -> Optional[LookupRequest]:
         """Next claimable request; sheds expired ones on the way (the
@@ -206,16 +225,20 @@ class AdmissionQueue:
             # client shed it while queued: already failed, skip
         return None
 
-    def take(self, max_batch: int, max_wait_s: float):
-        """Claim up to `max_batch` live requests: block for the first,
-        then linger up to `max_wait_s` for more. Returns [] only when
-        the queue is closed (the dispatcher's exit signal)."""
+    def take(self, max_batch: int, max_wait_s: float,
+             block: bool = True):
+        """Claim up to `max_batch` live requests: wait for the first
+        (`block=False` — the executor-driven drain — returns []
+        immediately instead, since a kick already guarantees a follow-up
+        drain for any later submit), then linger up to `max_wait_s` to
+        coalesce more (the micro-batch window). Returns [] when there is
+        nothing to claim (closed queue, or empty with block=False)."""
         with self._cond:
             while True:
                 first = self._pop_live_locked()
                 if first is not None:
                     break
-                if self._closed:
+                if self._closed or not block:
                     return []
                 self._cond.wait()
             out = [first]

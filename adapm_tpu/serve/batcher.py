@@ -4,7 +4,11 @@ DLRM-style inference is dominated by the embedding lookup path, and a
 dedicated request-coalescing layer in front of the parameter store is
 the standard lever (GraphVite's batched sample/lookup pipeline,
 PAPERS.md; "Dissecting Embedding Bag Performance in DLRM Inference").
-The `LookupBatcher` runs one dispatcher thread that
+The `LookupBatcher` dispatches as an event-driven drain program on the
+unified executor's `serve` stream (PR 6 — the dedicated dispatcher
+thread is subsumed by the executor pool; every `AdmissionQueue.submit`
+kicks a coalesced drain, and an idle plane owns no queued program). A
+drain
 
   1. takes up to `--sys.serve.max_batch` requests from the admission
      queue, lingering at most `--sys.serve.max_wait_us` after the first
@@ -31,9 +35,8 @@ tests/test_serve.py's storm test).
 """
 from __future__ import annotations
 
-import threading
 import time
-from typing import List, Optional
+from typing import List
 
 import numpy as np
 
@@ -42,7 +45,8 @@ from .admission import AdmissionQueue, LookupRequest
 
 
 class LookupBatcher:
-    """Owns the dispatcher thread; one per ServePlane."""
+    """Owns the dispatch logic (drain programs on the executor's
+    `serve` stream); one per ServePlane."""
 
     def __init__(self, server, opts, queue: AdmissionQueue,
                  shard: int = 0):
@@ -54,7 +58,7 @@ class LookupBatcher:
         # pools are one global sharded array, so any shard's rows are
         # one gather away in a single process)
         self.shard = int(shard)
-        self._thread: Optional[threading.Thread] = None
+        self._running = False
         reg = server.obs
         # shared=True: a plane rebuilt on the same server reuses the
         # metrics (single-registration discipline, docs/OBSERVABILITY.md)
@@ -72,47 +76,63 @@ class LookupBatcher:
     # -- lifecycle -----------------------------------------------------------
 
     def start(self) -> None:
-        if self._thread is not None:
+        if self._running:
             return
-        self._thread = threading.Thread(target=self._loop, daemon=True,
-                                        name="adapm-serve")
-        self._thread.start()
+        self._running = True
+        self.queue.set_kick(self._kick)
+        self._kick()  # drain anything admitted before start
 
     def stop(self) -> None:
-        """Close the queue (failing queued requests loudly) and join.
-        A dispatcher that does not exit within the join bound is WEDGED
-        (e.g. blocked on a dead remote owner's pull future) and still
-        reads through the server's pools — proceeding into pool
-        teardown would be a use-after-teardown, so this fail-stops
-        loudly instead (docs/failure_handling.md) and keeps the thread
-        handle (is_alive()/readiness stay truthful)."""
+        """Close the queue (failing queued requests loudly) and drain
+        the `serve` stream. A drain program that does not finish within
+        the bound is WEDGED (e.g. blocked on a dead remote owner's pull
+        future) and still reads through the server's pools — proceeding
+        into pool teardown would be a use-after-teardown, so this
+        fail-stops loudly instead (docs/failure_handling.md) and keeps
+        `_running` set (is_alive()/readiness stay truthful about the
+        live reader)."""
+        self.queue.set_kick(None)
         self.queue.close()
-        t = self._thread
-        if t is not None:
-            t.join(timeout=30)
-            if t.is_alive():
-                from ..utils import alog
-                alog("[serve] dispatcher failed to exit within 30s — "
-                     "wedged mid-dispatch (dead remote owner?)")
-                raise RuntimeError(
-                    "serve dispatcher wedged: did not exit within 30s "
-                    "of queue close; refusing to proceed into pool "
-                    "teardown under a live reader")
-            self._thread = None
+        ex = self.server.exec
+        if not ex.closed and not ex.drain("serve", timeout=30):
+            from ..utils import alog
+            alog("[serve] dispatcher failed to exit within 30s — "
+                 "wedged mid-dispatch (dead remote owner?)")
+            raise RuntimeError(
+                "serve dispatcher wedged: did not exit within 30s "
+                "of queue close; refusing to proceed into pool "
+                "teardown under a live reader")
+        self._running = False
 
     def is_alive(self) -> bool:
-        t = self._thread
-        return t is not None and t.is_alive()
+        """Dispatch capability: started, not stopped, and the executor
+        that runs the drain programs is still open."""
+        return self._running and not self.server.exec.closed
 
     # -- dispatcher ----------------------------------------------------------
 
-    def _loop(self) -> None:
+    def _kick(self) -> None:
+        """Queue one drain on the `serve` stream (coalesced: kicks
+        landing while a drain is queued are absorbed; a kick during a
+        RUNNING drain queues the next one, so no admitted request is
+        ever left undrained)."""
+        if self._running:
+            self.server.exec.submit("serve", self._drain,
+                                    label="serve.drain",
+                                    coalesce_key="serve.drain")
+
+    def _drain(self) -> None:
+        """Serve micro-batches until the queue is empty (one executor
+        program; FIFO on the `serve` stream). The non-blocking take
+        still LINGERS up to the micro-batch window after claiming a
+        first request — that linger is the coalescing lever and counts
+        as genuine stream-busy time."""
         max_batch = self.opts.serve_max_batch
         max_wait_s = self.opts.serve_max_wait_us * 1e-6
         while True:
-            reqs = self.queue.take(max_batch, max_wait_s)
+            reqs = self.queue.take(max_batch, max_wait_s, block=False)
             if not reqs:
-                return  # queue closed
+                return  # empty (or closed): park until the next kick
             try:
                 self._serve_batch(reqs)
             except BaseException as e:  # noqa: BLE001 — the dispatcher

@@ -29,6 +29,14 @@ import numpy as np
 
 from ..core import store as store_mod
 from ..core.store import OOB, pad_bucket
+from ..exec import dispatch_gate
+
+# sharded-dispatch serialization (docs/EXECUTOR.md): the gate brackets
+# each individual program ENQUEUE below — never the blocking
+# device->host readbacks or host-side merges these paths pay (holding
+# it across a readback would stall every other thread's dispatch
+# process-wide for the readback's duration)
+_GATE = dispatch_gate()
 
 # ---------------------------------------------------------------------------
 # jitted helpers (module level: jit cache shared across stores)
@@ -118,7 +126,9 @@ def gather_tiered(store, o_shard, o_slot, c_shard, c_slot, use_cache):
                    (c_shard, 0), (c_slot, OOB), (use_cache, False),
                    minimum=store.bucket_min)
     if not cold.any():
-        return store_mod._gather(store.main, store.cache, store.delta, *a)
+        with _GATE:
+            return store_mod._gather(store.main, store.cache,
+                                     store.delta, *a)
     t0 = time.perf_counter()
     b = a[0].shape[0]
     cold_vals = np.zeros((b, store.value_length),
@@ -126,8 +136,9 @@ def gather_tiered(store, o_shard, o_slot, c_shard, c_slot, use_cache):
     cold_vals[:n][cold] = store.cold[o_sh[cold], o_sl[cold]]
     use_cold = np.zeros(b, dtype=bool)
     use_cold[:n] = cold
-    out = _gather_cold(store.main, store.cache, store.delta, *a,
-                       cold_vals, use_cold)
+    with _GATE:
+        out = _gather_cold(store.main, store.cache, store.delta, *a,
+                           cold_vals, use_cold)
     if store.tier_hist is not None:
         store.tier_hist.observe(time.perf_counter() - t0)
     return out
@@ -148,8 +159,9 @@ def scatter_add_tiered(store, o_shard, o_slot, d_shard, d_slot, vals):
     a = pad_bucket(n, (o_sh.astype(np.int32), 0), (g_row, OOB),
                    (d_shard, 0), (d_slot, OOB), minimum=store.bucket_min)
     v = store._vals_bucket(rows, a[0].shape[0])
-    store.main, store.delta = store_mod._scatter_add(
-        store.main, store.delta, *a, v)
+    with _GATE:
+        store.main, store.delta = store_mod._scatter_add(
+            store.main, store.delta, *a, v)
 
 
 def set_rows_tiered(store, o_shard, o_slot, vals, c_shard, c_slot):
@@ -165,8 +177,10 @@ def set_rows_tiered(store, o_shard, o_slot, vals, c_shard, c_slot):
     a = pad_bucket(n, (o_sh.astype(np.int32), 0), (g_row, OOB),
                    (c_shard, 0), (c_slot, OOB), minimum=store.bucket_min)
     v = store._vals_bucket(rows, a[0].shape[0])
-    store.main, store.cache, store.delta = store_mod._set_rows(
-        store.main, store.cache, store.delta, a[0], a[1], v, a[2], a[3])
+    with _GATE:
+        store.main, store.cache, store.delta = store_mod._set_rows(
+            store.main, store.cache, store.delta, a[0], a[1], v,
+            a[2], a[3])
 
 
 def replica_create_tiered(store, o_shard, o_slot, c_shard, c_slot):
@@ -183,15 +197,17 @@ def replica_create_tiered(store, o_shard, o_slot, c_shard, c_slot):
                        (o_sh[hot].astype(np.int32), 0), (g_row[hot], OOB),
                        (c_sh[hot], 0), (c_sl[hot], OOB),
                        minimum=store.bucket_min)
-        store.cache, store.delta = store_mod._replica_create(
-            store.main, store.cache, store.delta, *a)
+        with _GATE:
+            store.cache, store.delta = store_mod._replica_create(
+                store.main, store.cache, store.delta, *a)
     if cold.any():
         vals = store.cold[o_sh[cold], o_sl[cold]]
         a = pad_bucket(int(cold.sum()), (c_sh[cold], 0), (c_sl[cold], OOB),
                        minimum=store.bucket_min)
         v = store._vals_bucket(vals, a[0].shape[0])
-        store.cache, store.delta = _install_cache_rows(
-            store.cache, store.delta, *a, v)
+        with _GATE:
+            store.cache, store.delta = _install_cache_rows(
+                store.cache, store.delta, *a, v)
 
 
 def sync_replicas_tiered(store, r_shard, r_cslot, o_shard, o_slot,
@@ -210,15 +226,16 @@ def sync_replicas_tiered(store, r_shard, r_cslot, o_shard, o_slot,
         a = pad_bucket(int(hot.sum()), (r_sh[hot], 0), (r_cs[hot], OOB),
                        (o_sh[hot].astype(np.int32), 0), (g_row[hot], OOB),
                        minimum=store.bucket_min)
-        if threshold > 0.0:
-            store.main, store.cache, store.delta = \
-                store_mod._sync_replicas_thresholded(
-                    store.main, store.cache, store.delta, *a,
-                    jnp.asarray(threshold, store.dtype))
-        else:
-            store.main, store.cache, store.delta = \
-                store_mod._sync_replicas(
-                    store.main, store.cache, store.delta, *a)
+        with _GATE:
+            if threshold > 0.0:
+                store.main, store.cache, store.delta = \
+                    store_mod._sync_replicas_thresholded(
+                        store.main, store.cache, store.delta, *a,
+                        jnp.asarray(threshold, store.dtype))
+            else:
+                store.main, store.cache, store.delta = \
+                    store_mod._sync_replicas(
+                        store.main, store.cache, store.delta, *a)
     if not cold.any():
         return
     t0 = time.perf_counter()
@@ -241,8 +258,9 @@ def sync_replicas_tiered(store, r_shard, r_cslot, o_shard, o_slot,
         a = pad_bucket(len(si), (r_sh[si], 0), (r_cs[si], OOB),
                        minimum=store.bucket_min)
         v = store._vals_bucket(fresh, a[0].shape[0])
-        store.cache, store.delta = _install_cache_rows(
-            store.cache, store.delta, *a, v)
+        with _GATE:
+            store.cache, store.delta = _install_cache_rows(
+                store.cache, store.delta, *a, v)
     if store.tier_hist is not None:
         store.tier_hist.observe(time.perf_counter() - t0)
 
@@ -278,7 +296,8 @@ def relocate_tiered(store, old_shard, old_slot, new_shard, new_slot,
         rows[has_rc] += d
         a = pad_bucket(int(has_rc.sum()), (rc_sh[has_rc], 0),
                        (rc_sl[has_rc], OOB), minimum=store.bucket_min)
-        store.delta = _clear_rows(store.delta, *a)
+        with _GATE:
+            store.delta = _clear_rows(store.delta, *a)
     # free the old residency (value already extracted), land cold
     release_rows(store, old_sh[valid], old_sl[valid])
     dst_ok = (new_sl >= 0) & (new_sl != OOB)

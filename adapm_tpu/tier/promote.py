@@ -19,13 +19,15 @@ dispatched (the topology_version discipline applied to residency).
 """
 from __future__ import annotations
 
-import threading
 from functools import partial
 
 import jax
 import numpy as np
 
 from ..core.store import OOB, pad_bucket
+from ..exec import dispatch_gate
+
+_GATE = dispatch_gate()  # sharded-dispatch serialization, docs/EXECUTOR.md
 
 
 @partial(jax.jit, donate_argnums=(0,))
@@ -55,7 +57,8 @@ def promote_rows(store, shard: int, slots: np.ndarray) -> int:
                    (rows.astype(np.int32), OOB),
                    minimum=store.bucket_min)
     v = store._vals_bucket(vals, a[0].shape[0])
-    store.main = _write_main_rows(store.main, a[0], a[1], v)
+    with _GATE:
+        store.main = _write_main_rows(store.main, a[0], a[1], v)
     res.dev_row[shard, take] = rows
     res.row_slot[shard, rows] = take
     res.epoch += 1
@@ -227,20 +230,29 @@ def ensure_hot_rows(server, store, shards: np.ndarray, slots: np.ndarray,
 
 
 class PromotionEngine:
-    """The tier maintenance worker: one background thread that
+    """The tier maintenance worker, as a self-rescheduling executor
+    task on the `tier` stream (adapm_tpu/exec; the dedicated thread +
+    condvar this class owned before PR 6 is subsumed by the executor's
+    worker pool). Each pass:
 
       1. drains the residency `want` queues (cold-miss and intent
-         promotion requests) into batched `ensure_hot_rows` calls;
-      2. pressure-demotes: keeps at least --sys.tier.demote_batch free
-         hot rows per shard so hot-path promotions rarely wait on a
-         victim readback;
+         promotion requests) into batched `ensure_hot_rows` calls —
+         DOUBLE-BUFFERED: the host-side prep of chunk N+1 (dedup,
+         coordinate split) runs on the `tier` stream while chunk N's
+         device scatter — committed on the `tier_commit` stream — is
+         still in flight (GraphVite's episodic transfer/compute
+         overlap; the exec.overlap_fraction gauge measures it);
+      2. pressure-demotes: keeps a bounded free-row headroom per shard
+         so hot-path promotions rarely wait on a victim readback;
       3. decays the access scores periodically (the CLOCK sweep).
 
-    Every mutating pass takes the server lock per batch (enqueue under
-    lock, device work dispatched async — the sync-round discipline);
-    candidate scans run outside it and revalidate via the residency
-    epoch. `run_once()` exposes one synchronous pass for deterministic
-    tests/tooling."""
+    Every mutating batch takes the server lock for revalidation +
+    ENQUEUE only (dispatch never — the lock-narrowing rule,
+    docs/EXECUTOR.md); candidate scans run outside it and revalidate
+    via the residency epoch. `run_once()` exposes one synchronous pass
+    for deterministic tests/tooling. A pass that moved rows reschedules
+    itself; an idle pass parks (no queued task — the executor worker
+    parks on its condvar, pinned by scripts/exec_overlap_check.py)."""
 
     _INTERVAL_S = 0.02
     _DECAY_EVERY = 64
@@ -249,51 +261,45 @@ class PromotionEngine:
         self.server = server
         self.opts = opts
         self.manager = manager
-        self._cond = threading.Condition()
         self._stop = False
-        self._kicked = False
         self._passes = 0
-        self._thread: threading.Thread | None = None
 
     # -- producer ------------------------------------------------------------
 
     def kick(self) -> None:
-        with self._cond:
-            self._kicked = True
-            if self._thread is None and not self._stop:
-                self._thread = threading.Thread(
-                    target=self._loop, daemon=True, name="adapm-tier")
-                self._thread.start()
-            self._cond.notify_all()
+        """Queue one maintenance pass (coalesced: a pass already queued
+        absorbs the kick; a running pass reschedules itself while it
+        finds work)."""
+        if self._stop:
+            return
+        self.server.exec.submit("tier", self._pass,
+                                label="tier.maintain",
+                                coalesce_key="tier.maintain")
 
     # -- worker --------------------------------------------------------------
 
-    def _loop(self) -> None:
+    def _pass(self) -> None:
         from ..utils import alog
-        idle = False
-        while True:
-            with self._cond:
-                if not self._kicked and not self._stop:
-                    # park indefinitely once a pass did no work: an idle
-                    # server must not keep a thread polling (and doing
-                    # late-teardown device ops); any new want kicks us.
-                    # The _stop guard matters: close()'s notify is lost
-                    # if it lands while we are mid-pass (no waiter), so
-                    # re-entering an indefinite wait with _stop already
-                    # set would stall shutdown until the join timeout.
-                    self._cond.wait(None if idle
-                                    else self._INTERVAL_S * 5)
-                self._kicked = False
-                if self._stop:
-                    return
-            try:
-                idle = self.run_once() == 0
-            except Exception as e:  # noqa: BLE001 — keep the worker up
-                idle = False
-                alog(f"[tier] maintenance pass failed: "
-                     f"{type(e).__name__}: {e}")
-            import time
-            time.sleep(self._INTERVAL_S)
+        if self._stop:
+            return
+        delay = self._INTERVAL_S
+        try:
+            moved = self.run_once()
+        except Exception as e:  # noqa: BLE001 — keep the worker up
+            # retry after a backoff (the pre-PR thread loop's behavior):
+            # a transient failure must not strand queued wants, pressure
+            # demotion, and the CLOCK decay until the next external kick
+            moved = 1
+            delay = self._INTERVAL_S * 5
+            alog(f"[tier] maintenance pass failed: "
+                 f"{type(e).__name__}: {e}")
+        if moved and not self._stop:
+            # work found (or a failed pass retrying): keep draining at
+            # the maintenance cadence
+            self.server.exec.submit("tier", self._pass,
+                                    label="tier.maintain",
+                                    coalesce_key="tier.maintain",
+                                    delay=delay)
 
     def run_once(self) -> int:
         """One maintenance pass (see class doc). Safe to call from any
@@ -304,6 +310,13 @@ class PromotionEngine:
         moved = 0
         min_clock = mgr._min_active_clock()
         batch = max(1, self.opts.tier_demote_batch)
+        ex = srv.exec
+        # double-buffering needs a second worker to run the commit
+        # while this pass preps the next chunk; the serialized fallback
+        # (--sys.exec.single_stream) and a closing executor commit
+        # inline — same results, no overlap
+        pipelined = (not ex.single_stream and not ex.closed
+                     and ex.max_workers >= 2)
         for st in srv.stores:
             res = st.res
             # 1. drain promotion wants — deduplicated, then processed in
@@ -321,17 +334,31 @@ class PromotionEngine:
                 sh = np.concatenate([w[0] for w in wants]).astype(np.int64)
                 sl = np.concatenate([w[1] for w in wants]).astype(np.int64)
                 pair = np.unique(sh * np.int64(res.main_slots) + sl)
-                sh = (pair // res.main_slots).astype(np.int32)
-                sl = (pair % res.main_slots).astype(np.int32)
-                for lo in range(0, len(sh), 4 * batch):
-                    hi = lo + 4 * batch
-                    with srv._lock:
-                        n = ensure_hot_rows(srv, st, sh[lo:hi],
-                                            sl[lo:hi],
-                                            min_clock=min_clock)
-                    if n:
-                        moved += n
-                        mgr.c_promotions.inc(n)
+                # DOUBLE-BUFFERED drain: chunk N commits (server lock ->
+                # revalidate -> cold-row copy -> device scatter enqueue)
+                # on the `tier_commit` stream while this pass preps
+                # chunk N+1's coordinates on the `tier` stream — at most
+                # one commit in flight, so host prep of batch N+1
+                # overlaps the device scatter of batch N and nothing
+                # runs unboundedly ahead
+                prev = None
+                for lo in range(0, len(pair), 4 * batch):
+                    p = pair[lo: lo + 4 * batch]
+                    csh = (p // res.main_slots).astype(np.int32)
+                    csl = (p % res.main_slots).astype(np.int32)
+                    commit = partial(self._commit_chunk, st, csh, csl,
+                                     min_clock)
+                    if pipelined:
+                        cur = ex.submit("tier_commit", commit,
+                                        label="tier.promote_commit")
+                    else:
+                        cur = None
+                        moved += commit()
+                    if prev is not None:
+                        moved += self._commit_result(prev)
+                    prev = cur
+                if prev is not None:
+                    moved += self._commit_result(prev)
             # 2. pressure demotion: keep a MODEST free-row headroom per
             # shard so hot-path promotions rarely pay a victim readback
             # — bounded by a fraction of the pool, NOT the raw batch
@@ -365,13 +392,33 @@ class PromotionEngine:
                 st.res.decay()
         return moved
 
+    def _commit_chunk(self, st, sh: np.ndarray, sl: np.ndarray,
+                      min_clock: int) -> int:
+        """Commit one promotion chunk: server lock -> coordinate
+        revalidation -> program enqueue (the lock-narrowing rule —
+        dispatch itself is async under the gate)."""
+        srv = self.server
+        with srv._lock:
+            n = ensure_hot_rows(srv, st, sh, sl, min_clock=min_clock)
+        if n:
+            self.manager.c_promotions.inc(n)
+        return n
+
+    @staticmethod
+    def _commit_result(completion) -> int:
+        """Join one in-flight commit; a commit cancelled by executor
+        close counts zero (teardown path)."""
+        n = completion.result(timeout=60)
+        return int(n or 0)
+
     def close(self) -> None:
-        """Stop the worker (idempotent; joins the thread so it can
-        never outlive the server into pool teardown)."""
-        with self._cond:
-            self._stop = True
-            self._cond.notify_all()
-        t = self._thread
-        if t is not None:
-            t.join(timeout=30)
-            self._thread = None
+        """Stop the worker (idempotent; drains the tier streams so no
+        maintenance pass can outlive the server into pool teardown)."""
+        self._stop = True
+        ex = self.server.exec
+        if not ex.closed:
+            if not ex.drain("tier", timeout=30) or \
+                    not ex.drain("tier_commit", timeout=30):
+                from ..utils import alog
+                alog("[tier] maintenance pass failed to drain within "
+                     "30s of close")

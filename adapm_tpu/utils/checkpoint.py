@@ -46,9 +46,13 @@ def _launder(x):
     global _launder_fn
     import jax
     import jax.numpy as jnp
+
+    from ..exec import dispatch_gate
     if _launder_fn is None:
         _launder_fn = jax.jit(lambda a: jnp.copy(a))
-    return _launder_fn(x)
+    with dispatch_gate():  # sharded program: one enqueue order per
+        # device set (docs/EXECUTOR.md)
+        return _launder_fn(x)
 
 
 def rank_path(path: str, rank: int) -> str:

@@ -553,6 +553,89 @@ def bench_tier(E=40_000, d=32, B=1024, steps=60, warmup=20,
     return res
 
 
+def bench_exec(E=40_000, d=32, B=1024, steps=60, warmup=20,
+               skew=16.0, hot_frac=0.25):
+    """Unified-executor phase (ISSUE 6): wall time of a tiered
+    KGE-shaped workload WITH PROMOTION CHURN — zipf pull+push over a
+    25%-capacity hot pool, the maintenance worker kicked throughout, so
+    promotion batch prep genuinely competes with the training thread's
+    dispatches — overlapped (the multi-stream executor default) vs
+    serialized (--sys.exec.single_stream, one worker — background
+    programs strictly one at a time, no double-buffering). One fixed batch schedule is shared by both
+    configurations; the drain of the queued maintenance backlog is
+    INSIDE the timed window (a serialized executor pays it at the end,
+    the overlapped one retires it concurrently — GraphVite's episodic
+    transfer/compute overlap). The artifact records both wall times,
+    the ratio, the overlap_fraction gauge under churn, and the
+    overlapped server's full exec metrics section."""
+    import adapm_tpu
+    import jax
+    from adapm_tpu.config import SystemOptions
+
+    L = 2 * d
+    S = len(jax.devices())
+    rng = np.random.default_rng(0)
+    sched = [(E * rng.random(B) ** skew).astype(np.int64).clip(0, E - 1)
+             for _ in range(warmup + steps)]
+    init = np.random.default_rng(1).normal(
+        size=(E, L)).astype(np.float32)
+    upd = (np.random.default_rng(2).normal(
+        size=(B, L)).astype(np.float32) * 1e-3)
+    hot_rows = max(8, -(-int(E * hot_frac) // S))
+
+    def run_config(single_stream):
+        srv = adapm_tpu.setup(E, L, opts=SystemOptions(
+            sync_max_per_sec=0, prefetch=False,
+            tier=True, tier_hot_rows=hot_rows,
+            exec_single_stream=single_stream))
+        w = srv.make_worker(0)
+        slab = 50_000
+        for lo in range(0, E, slab):
+            hi = min(lo + slab, E)
+            w.set(np.arange(lo, hi), init[lo:hi])
+        for b in sched[:warmup]:
+            w.pull_sync(b)
+            w.push(b, upd)
+            srv.tier.maintain()
+        srv.block()
+        t0 = time.perf_counter()
+        for i, b in enumerate(sched[warmup:]):
+            w.pull_sync(b)
+            w.push(b, upd)
+            if i % 4 == 0:
+                srv.tier.engine.kick()
+        srv.exec.drain("tier", timeout=120)
+        srv.exec.drain("tier_commit", timeout=120)
+        srv.block()
+        dt = time.perf_counter() - t0
+        out = {"wall_s": round(dt, 4),
+               "keys_per_sec": round(2 * steps * B / dt, 1),
+               "overlap_fraction":
+                   round(srv.exec.overlap_fraction(), 4),
+               "exec_stats": {k: round(v, 4) if isinstance(v, float)
+                              else v
+                              for k, v in srv.exec.stats().items()}}
+        if not single_stream:
+            out["metrics"] = srv.metrics_snapshot()
+        srv.shutdown()
+        return out
+
+    _progress(f"exec phase: serialized single-stream fallback "
+              f"({E} keys, B={B}, hot {int(hot_frac * 100)}%)")
+    ser = run_config(True)
+    _progress("exec phase: overlapped multi-stream default")
+    over = run_config(False)
+    ratio = over["wall_s"] / max(1e-9, ser["wall_s"])
+    _progress(f"exec phase: overlapped/serialized wall ratio "
+              f"{ratio:.3f}, overlap_fraction "
+              f"{over['overlap_fraction']:.3f}")
+    return {"keys_per_lookup": B,
+            "hot_rows_per_shard": hot_rows,
+            "overlapped": over,
+            "serialized": ser,
+            "overlapped_vs_serialized_wall_ratio": round(ratio, 3)}
+
+
 def bench_w2v(V=100_000, d=128, B=8192, N=5, steps=40, warmup=4,
               scan_steps=1) -> float:
     """word2vec SGNS fused-step throughput (pairs/sec) with on-device
@@ -801,6 +884,17 @@ def _phase_tier():
     return out
 
 
+def _phase_exec():
+    import jax
+    sz = {"E": 10_000, "B": 512, "steps": 30, "warmup": 12} \
+        if os.environ.get("ADAPM_BENCH_SMALL") else {}
+    out = bench_exec(**sz)
+    out["virtual_shards"] = len(jax.devices("cpu"))
+    if sz:
+        out["small_sizes"] = sz
+    return out
+
+
 def _phase_w2v():
     if os.environ.get("ADAPM_BENCH_SMALL"):
         small = dict(V=20_000, d=64, B=2048, warmup=2)
@@ -830,14 +924,14 @@ def _phase_cpu():
 _PHASES = {"probe": _phase_probe, "kge": _phase_kge,
            "prefetch": _phase_prefetch, "scan": _phase_scan,
            "dedup": _phase_dedup, "pm": _phase_pm, "mgmt": _phase_mgmt,
-           "serve": _phase_serve, "tier": _phase_tier, "w2v": _phase_w2v,
-           "cpu": _phase_cpu}
+           "serve": _phase_serve, "tier": _phase_tier,
+           "exec": _phase_exec, "w2v": _phase_w2v, "cpu": _phase_cpu}
 
 # generous per-phase walls: a healthy phase finishes in a fraction of
 # these; a wedged relay burns one wall once, then the driver degrades
 _TIMEOUTS = {"probe": 120, "kge": 1200, "prefetch": 1200, "scan": 900,
              "dedup": 900, "pm": 900, "mgmt": 900, "serve": 900,
-             "tier": 900, "w2v": 900, "cpu": 600}
+             "tier": 900, "exec": 900, "w2v": 900, "cpu": 600}
 
 _CPU_ENV = {"JAX_PLATFORMS": "cpu", "ADAPM_PLATFORM": "cpu",
             "ADAPM_BENCH_SMALL": "1"}
@@ -952,6 +1046,11 @@ def main():
     # untiered-vs-tiered comparison needs both configurations on the
     # same backend, and the cold path's cost is host<->device traffic
     results["tier"] = _run_phase("tier", pm_env)
+    # unified-executor phase (ISSUE 6): host-CPU by design — the
+    # overlapped-vs-serialized comparison needs both executor
+    # configurations on the same backend, and the overlap being
+    # measured is host prep vs device dispatch on this host
+    results["exec"] = _run_phase("exec", pm_env)
     results["cpu"] = _run_phase("cpu")
 
     def phase_val(name, field):
@@ -1018,6 +1117,8 @@ def main():
                   else {"error": "serve failed"}),
         "tier": (results["tier"] if _ok(results["tier"])
                  else {"error": "tier failed"}),
+        "exec": (results["exec"] if _ok(results["exec"])
+                 else {"error": "exec failed"}),
         "w2v_pairs_per_sec": round(w2v, 1),
         "dedup": {"unique_batch_triples_per_sec": round(tput_unique, 1),
                   "gain_vs_skewed":

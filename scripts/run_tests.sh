@@ -29,5 +29,10 @@ python scripts/serve_latency_check.py
 # all-cold configuration must read bit-identically to untiered, and
 # the all-hot tiered pull path must stay near parity with untiered
 python scripts/tier_residency_check.py
+# unified-executor guard (ISSUE 6): an idle executor starts zero
+# programs (workers park on the condvar), and the overlapped default
+# must keep up with the serialized single-stream fallback on a tiered
+# promotion-churn workload (median pairwise ratio; overlap_fraction > 0)
+python scripts/exec_overlap_check.py
 python -m pytest tests/ -q "$@"
 echo "ALL TESTS PASSED"

@@ -1,0 +1,362 @@
+"""Unified async executor (ISSUE 6 tentpole; adapm_tpu/exec).
+
+Two layers of coverage:
+
+ 1. Executor mechanics — per-stream FIFO, free cross-stream
+    interleaving, `after` edges, coalescing, delayed eligibility,
+    error containment, idempotent close with cancellation, drain,
+    the serialized single-stream fallback, and the overlap accounting.
+
+ 2. THE enqueue-order property test — a randomized interleaving of all
+    five subsystem producers (fused-path writes, prefetch
+    intents/pumped rounds, tier promotion/demotion churn, serve
+    lookups, sync rounds) driven IDENTICALLY through a default
+    (overlapped, multi-stream) server and a --sys.exec.single_stream
+    (serialized shadow) server: every read — whole-table read_main,
+    duplicate-heavy pulls, and served lookups — must be bit-identical
+    between the two at every step and after quiesce. Stream
+    interleaving is a scheduling freedom, never a value-visible one.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import adapm_tpu
+from adapm_tpu.config import SystemOptions
+from adapm_tpu.exec import AsyncExecutor, dispatch_gate
+
+E = 384
+L = 8
+
+
+# ---------------------------------------------------------------------------
+# 1. executor mechanics
+# ---------------------------------------------------------------------------
+
+
+def test_stream_fifo_order():
+    ex = AsyncExecutor(workers=4)
+    order = []
+    lock = threading.Lock()
+
+    def mk(i):
+        def fn():
+            with lock:
+                order.append(i)
+        return fn
+
+    last = None
+    for i in range(50):
+        last = ex.submit("s", mk(i))
+    assert last.wait(10)
+    assert order == list(range(50)), "stream order must be submission order"
+    ex.close()
+
+
+def test_streams_interleave_and_after_edges():
+    ex = AsyncExecutor(workers=4)
+    events = []
+    lock = threading.Lock()
+    gate_a = threading.Event()
+
+    def slow_a():
+        gate_a.wait(10)
+        with lock:
+            events.append("a")
+
+    def fast_b():
+        with lock:
+            events.append("b")
+
+    ca = ex.submit("a", slow_a)
+    cb = ex.submit("b", fast_b)
+    assert cb.wait(10)           # b finishes while a is still blocked:
+    assert not ca.done()         # distinct streams interleave freely
+    gate_a.set()
+    assert ca.wait(10)
+    # after= orders across streams without any lock held
+    c1 = ex.submit("a", lambda: events.append("first"))
+    c2 = ex.submit("b", lambda: events.append("second"), after=[c1])
+    assert c2.wait(10)
+    assert events.index("first") < events.index("second")
+    ex.close()
+
+
+def test_coalesce_key_absorbs_queued_duplicates():
+    ex = AsyncExecutor(workers=1)
+    block = threading.Event()
+    ran = []
+    ex.submit("s", lambda: block.wait(10))          # occupy the stream
+    c1 = ex.submit("s", lambda: ran.append(1), coalesce_key="k")
+    c2 = ex.submit("s", lambda: ran.append(2), coalesce_key="k")
+    assert c2 is c1, "queued same-key program is reused, not duplicated"
+    block.set()
+    assert c1.wait(10)
+    assert ran == [1]
+    ex.close()
+
+
+def test_delay_and_coalesce_tightening():
+    ex = AsyncExecutor(workers=2)
+    t0 = time.monotonic()
+    c = ex.submit("s", lambda: time.monotonic(), delay=0.15)
+    done_at = c.result(10)
+    assert done_at - t0 >= 0.14, "delayed program ran before eligibility"
+    # a later zero-delay submission with the same key tightens the
+    # existing program's eligibility to now
+    c1 = ex.submit("s", lambda: "x", coalesce_key="k", delay=30.0)
+    c2 = ex.submit("s", lambda: "y", coalesce_key="k", delay=0.0)
+    assert c2 is c1
+    assert c1.wait(10), "tightened program must run promptly, not in 30s"
+    ex.close()
+
+
+def test_error_containment_and_result():
+    ex = AsyncExecutor(workers=2)
+
+    def boom():
+        raise ValueError("program failed")
+
+    c = ex.submit("s", boom)
+    with pytest.raises(ValueError, match="program failed"):
+        c.result(10)
+    # the pool survives a failing program
+    assert ex.submit("s", lambda: 41 + 1).result(10) == 42
+    ex.close()
+
+
+def test_close_idempotent_cancels_queued():
+    ex = AsyncExecutor(workers=1)
+    block = threading.Event()
+    ex.submit("s", lambda: block.wait(10))
+    queued = ex.submit("s", lambda: "never")
+    block.set()
+    ex.close()
+    ex.close()  # idempotent
+    assert ex.closed
+    # a queued program either ran or was cancelled — and after close,
+    # late submissions come back pre-cancelled instead of crashing
+    assert queued.done()
+    late = ex.submit("s", lambda: 1)
+    assert late.done() and late.cancelled
+    assert ex.live_streams() == []
+
+
+def test_drain_and_queue_depth():
+    ex = AsyncExecutor(workers=2)
+    started = threading.Event()
+    block = threading.Event()
+
+    def blocker():
+        started.set()
+        block.wait(10)
+
+    ex.submit("s", blocker)
+    assert started.wait(10)              # the runner has DEQUEUED it
+    ex.submit("s", lambda: None)
+    assert ex.queue_depth("s") == 1      # one queued behind the runner
+    assert not ex.drain("s", timeout=0.2)
+    block.set()
+    assert ex.drain("s", timeout=10)
+    assert ex.queue_depth() == 0
+    ex.close()
+
+
+def test_single_stream_serializes_everything():
+    ex = AsyncExecutor(workers=4, single_stream=True)
+    assert ex.max_workers == 1
+    order = []
+    lock = threading.Lock()
+
+    def mk(tag):
+        def fn():
+            with lock:
+                order.append(tag)
+            time.sleep(0.002)
+        return fn
+
+    cs = []
+    for i in range(10):
+        cs.append(ex.submit(f"stream{i % 3}", mk(i)))
+    for c in cs:
+        assert c.wait(10)
+    assert order == list(range(10)), \
+        "single-stream fallback must run ready programs strictly " \
+        "oldest-first across streams (one worker)"
+    assert ex.stats()["overlap_fraction"] == 0.0
+    ex.close()
+
+
+def test_overlap_accounting_sees_concurrent_streams():
+    ex = AsyncExecutor(workers=4)
+    b1, b2 = threading.Event(), threading.Event()
+    c1 = ex.submit("a", lambda: b1.wait(10))
+    c2 = ex.submit("b", lambda: b2.wait(10))
+    time.sleep(0.15)             # both streams demonstrably busy
+    b1.set(), b2.set()
+    assert c1.wait(10) and c2.wait(10)
+    st = ex.stats()
+    assert st["overlap_s"] > 0.1, "two busy streams must count as overlap"
+    assert 0.0 < st["overlap_fraction"] <= 1.0
+    ex.close()
+
+
+def test_single_stream_keeps_stream_identity():
+    """Streams are NOT collapsed in the serialized fallback: a drain of
+    one subsystem's stream must not wait on another subsystem's
+    self-rescheduling program, and a delayed head blocks only its own
+    stream (regression: the collapsed design made drain('serve') wait
+    on a perpetually-resubmitting sync tick — every single-stream
+    shutdown stalled its full timeout and raised)."""
+    ex = AsyncExecutor(workers=4, single_stream=True)
+    stop = threading.Event()
+
+    def tick():
+        if not stop.is_set():
+            ex.submit("sync", tick, delay=0.01)  # self-rescheduling
+
+    ex.submit("sync", tick)
+    # a delayed program parked on another stream must not gate this one
+    ex.submit("prefetch", lambda: None, delay=30.0)
+    ran = ex.submit("serve", lambda: "served")
+    assert ran.result(5) == "served"
+    t0 = time.monotonic()
+    assert ex.drain("serve", timeout=5), \
+        "draining 'serve' must not wait on the sync stream"
+    assert time.monotonic() - t0 < 2.0
+    stop.set()
+    ex.close()
+
+
+def test_single_stream_server_shutdown_with_sync_and_serve(rng):
+    """End-to-end single-stream regression: a --sys.exec.single_stream
+    server running the background sync rounds AND a serve plane AND
+    tier maintenance shuts down promptly (the per-subsystem drains in
+    stop()/close() target their own streams)."""
+    from adapm_tpu.serve import ServePlane
+    srv = _mk_server(True)
+    w = srv.make_worker(0)
+    w.set(np.arange(E), rng.normal(size=(E, L)).astype(np.float32))
+    plane = ServePlane(srv)
+    sess = plane.session()
+    srv.start_sync_thread()
+    srv.tier.engine.kick()
+    assert np.asarray(sess.lookup(np.arange(8))).shape == (8, L)
+    t0 = time.monotonic()
+    srv.shutdown()
+    assert time.monotonic() - t0 < 25.0, \
+        "single-stream shutdown stalled on a cross-subsystem drain"
+    assert srv.exec.live_streams() == []
+
+
+def test_dispatch_gate_is_reentrant_process_wide():
+    g1, g2 = dispatch_gate(), dispatch_gate()
+    assert g1 is g2, "one gate per process"
+    with g1:
+        with g2:     # reentrant: nested store ops must not self-deadlock
+            pass
+
+
+# ---------------------------------------------------------------------------
+# 2. THE enqueue-order property test: five producers, overlapped vs
+#    serialized shadow, bit-identical reads
+# ---------------------------------------------------------------------------
+
+
+def _mk_server(single_stream: bool):
+    opts = SystemOptions(sync_max_per_sec=0, prefetch=True,
+                         prefetch_pull="off",  # staging value-invisible
+                         # anyway; off keeps the pumped-round count (the
+                         # value-visible part) exactly 1 per pump on
+                         # both servers
+                         tier=True, tier_hot_rows=16,
+                         exec_single_stream=single_stream)
+    return adapm_tpu.setup(E, L, opts=opts)
+
+
+def test_enqueue_order_property_five_producers(rng):
+    from adapm_tpu.serve import ServePlane
+    srv = _mk_server(False)          # overlapped default
+    ref = _mk_server(True)           # serialized shadow
+    w, wr = srv.make_worker(0), ref.make_worker(0)
+    plane, rplane = ServePlane(srv), ServePlane(ref)
+    sess, rsess = plane.session(), rplane.session()
+    vals = rng.normal(size=(E, L)).astype(np.float32)
+    for ww in (w, wr):
+        ww.set(np.arange(E), vals)
+    keys = np.arange(E)
+
+    def settle():
+        # drain the value-visible background work (pumped planner
+        # rounds) so both servers compare at the same logical point;
+        # tier maintenance and staging stay free-running — they are
+        # value-invisible by contract
+        srv.prefetch.flush()
+        ref.prefetch.flush()
+
+    for step in range(40):
+        op = int(rng.integers(0, 6))
+        if op == 0:      # fused-path writes (producer 1: main stream)
+            ks = rng.integers(0, E, 24)
+            v = rng.normal(size=(24, L)).astype(np.float32)
+            w.push(ks, v)
+            wr.push(ks, v)
+        elif op == 1:    # prefetch pipeline (producer 2): intent + one
+            #                pumped planner round on the exec stream
+            ks = rng.choice(keys[srv.ab.owner[keys] != w.shard], 16,
+                            replace=False)
+            end = int(w.current_clock + rng.integers(1, 4))
+            w.intent(ks, w.current_clock, end)
+            wr.intent(ks, wr.current_clock, end)
+            srv.drive_rounds(1)
+            ref.drive_rounds(1)
+            settle()
+        elif op == 2:    # tier maintenance (producer 3): churn + kick
+            ks = rng.choice(E, 24, replace=False)
+            srv.tier.promote_keys(ks)
+            ref.tier.promote_keys(ks)
+            srv.tier.demote_keys(ks[:12])
+            ref.tier.demote_keys(ks[:12])
+            srv.tier.engine.kick()
+            ref.tier.engine.kick()
+        elif op == 3:    # serve plane (producer 4): coalesced lookups
+            ks = rng.integers(0, E, 20)
+            got = sess.lookup(ks)
+            expect = rsess.lookup(ks)
+            assert np.array_equal(np.asarray(got), np.asarray(expect)), \
+                f"step {step}: served lookup diverged"
+        elif op == 4:    # sync rounds (producer 5)
+            srv.sync.run_round(force_intents=True, all_channels=True)
+            ref.sync.run_round(force_intents=True, all_channels=True)
+        else:            # relocation (topology churn under everything)
+            ks = rng.choice(E, 12, replace=False)
+            dest = int(rng.integers(0, srv.num_shards))
+            srv._relocate_to(ks, dest)
+            ref._relocate_to(ks, dest)
+        if rng.integers(0, 3) == 0:
+            w.advance_clock()
+            wr.advance_clock()
+        settle()
+        a = np.asarray(srv.read_main(keys))
+        b = np.asarray(ref.read_main(keys))
+        assert np.array_equal(a, b), (
+            f"step {step} (op {op}): overlapped read diverged from "
+            f"serialized shadow ({int((a != b).sum())} floats differ)")
+        pk = rng.integers(0, E, 20)
+        assert np.array_equal(np.asarray(w.pull_sync(pk)),
+                              np.asarray(wr.pull_sync(pk))), \
+            f"step {step}: pull diverged"
+    srv.quiesce()
+    ref.quiesce()
+    assert np.array_equal(np.asarray(srv.read_main(keys)),
+                          np.asarray(ref.read_main(keys))), \
+        "after quiesce: overlapped state diverged from serialized shadow"
+    # the overlapped server used multiple streams; the shadow used one
+    assert ref.exec.single_stream and not srv.exec.single_stream
+    plane.close()
+    rplane.close()
+    srv.shutdown()
+    ref.shutdown()
+    assert srv.exec.live_streams() == [] and ref.exec.live_streams() == []

@@ -202,7 +202,7 @@ def test_metrics_snapshot_schema_stable():
     # readiness; {} until a ServePlane is attached); v4 = the PR 5 tier
     # section (tiered-storage hot-hit/promotion metrics; {} while
     # --sys.tier is off)
-    assert snap["schema_version"] == 4 and snap["metrics_enabled"]
+    assert snap["schema_version"] == 5 and snap["metrics_enabled"]
     assert snap["serve"] == {}  # no ServePlane on this server
     assert snap["tier"] == {}   # --sys.tier off on this server
     for sec in srv._SNAPSHOT_SECTIONS:

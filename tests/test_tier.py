@@ -26,20 +26,16 @@ E = 384
 L = 8
 
 
-def _mk(tier: bool, hot_rows: int = 16, worker: bool = False, **kw):
+def _mk(tier: bool, hot_rows: int = 16, **kw):
+    # Until PR 6, two-server tests had to null the tier worker's kick:
+    # concurrent sharded-program dispatch from two lock domains could
+    # deadlock XLA-CPU's collective rendezvous. The unified executor's
+    # dispatch gate serializes every sharded enqueue process-wide
+    # (docs/EXECUTOR.md), so the worker now runs EVERYWHERE — including
+    # the two-servers-on-one-device storm below (the regression shape).
     opts = SystemOptions(sync_max_per_sec=0, prefetch=False,
                          tier=tier, tier_hot_rows=hot_rows, **kw)
-    srv = adapm_tpu.setup(E, L, opts=opts)
-    if tier and not worker:
-        # several tests run TWO servers against the same virtual device
-        # set; concurrent sharded-program dispatch from the tier worker
-        # (under THIS server's lock) and the main thread (under the
-        # OTHER server's lock) deadlocks XLA-CPU's collective
-        # rendezvous — a two-servers-per-process harness artifact, not
-        # a production shape. Drive maintenance synchronously via
-        # tier.maintain() instead.
-        srv.tier.engine.kick = lambda: None
-    return srv
+    return adapm_tpu.setup(E, L, opts=opts)
 
 
 def _read_all(srv):
@@ -184,7 +180,7 @@ def test_tier_metrics_section_schema_v4(rng):
     w.pull_sync(np.arange(0, 64))
     srv.tier.promote_keys(np.arange(0, 16))
     snap = srv.metrics_snapshot()
-    assert snap["schema_version"] == 4
+    assert snap["schema_version"] == 5
     t = snap["tier"]
     assert t["promotions"] >= 16
     assert 0.0 <= t["hot_hit_rate"] <= 1.0
@@ -292,25 +288,84 @@ def test_tiered_negative_fallback_promotes_all_cold(rng):
 
 
 # ---------------------------------------------------------------------------
+# r10 known-limit regression (retired by the PR 6 dispatch gate)
+# ---------------------------------------------------------------------------
+
+
+def test_two_servers_concurrent_sharded_dispatch_bounded(rng):
+    """Two servers sharing this process's virtual device set dispatch
+    sharded programs CONCURRENTLY — tier maintenance enabled on both
+    (executor `tier` streams) plus a driving thread per server pushing,
+    pulling, and churning residency — and every join is bounded. The
+    old failure mode was an indefinite XLA-CPU collective-rendezvous
+    stall whenever two lock domains interleaved per-device enqueue
+    orders; the process-wide dispatch gate (adapm_tpu/exec) makes the
+    orders identical by construction, so the former workaround (nulling
+    the worker's kick and driving tier.maintain() synchronously) is
+    gone for good."""
+    import threading
+    srv1 = _mk(True, hot_rows=16)
+    srv2 = _mk(True, hot_rows=16)
+    vals = rng.normal(size=(E, L)).astype(np.float32)
+    w1, w2 = srv1.make_worker(0), srv2.make_worker(0)
+    w1.set(np.arange(E), vals)
+    w2.set(np.arange(E), vals)
+    errs = []
+
+    def churn(srv, w, seed):
+        r = np.random.default_rng(seed)
+        try:
+            for _ in range(12):
+                ks = r.integers(0, E, 16)
+                w.push(ks, r.normal(size=(16, L)).astype(np.float32))
+                srv.tier.promote_keys(r.choice(E, 24, replace=False))
+                srv.tier.demote_keys(r.choice(E, 24, replace=False))
+                srv.tier.engine.kick()  # async passes on the executor
+                w.pull_sync(r.integers(0, E, 16))
+        except BaseException as e:  # noqa: BLE001 — surface in-thread
+            errs.append(e)
+
+    ts = [threading.Thread(target=churn, args=(srv1, w1, 1)),
+          threading.Thread(target=churn, args=(srv2, w2, 2))]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=60)
+    assert not any(t.is_alive() for t in ts), \
+        "concurrent sharded dispatch stalled — rendezvous deadlock?"
+    assert not errs, errs
+    # bounded shutdown too: both executors drain without a stall
+    srv1.shutdown()
+    srv2.shutdown()
+
+
+# ---------------------------------------------------------------------------
 # shutdown ordering satellite
 # ---------------------------------------------------------------------------
 
 
 def test_shutdown_deterministic_and_double_close(rng):
     from adapm_tpu.serve import ServePlane
-    srv = _mk(True, hot_rows=16, worker=True)  # real tier worker thread
+    srv = _mk(True, hot_rows=16)
     w = srv.make_worker(0)
     w.set(np.arange(E), rng.normal(size=(E, L)).astype(np.float32))
     plane = ServePlane(srv)
     plane.session().lookup(np.arange(8))
-    srv.tier.engine.kick()   # make sure the tier worker thread exists
+    srv.tier.engine.kick()   # queue real tier maintenance work
     srv.start_sync_thread()
     srv.shutdown()
-    # every background thread is down after the first shutdown
+    # every background producer is down after the first shutdown, and
+    # the unified executor closed LAST with nothing left on its streams
     assert srv._sync_thread is None
-    assert srv.tier.engine._thread is None
     assert not plane.batcher.is_alive()
+    assert srv.exec.closed
+    assert srv.exec.live_streams() == [], \
+        "orphaned executor streams survived shutdown"
     srv.shutdown()  # double-close must be a no-op, not a crash
+    # a submit against the closed executor is a cancelled no-op, not a
+    # crash (late kicks during teardown)
+    c = srv.exec.submit("tier", lambda: 1)
+    assert c.done() and c.cancelled
     # and a manually-closed plane before shutdown stays tolerated
     srv2 = _mk(True, hot_rows=16)
     p2 = ServePlane(srv2)
@@ -343,14 +398,17 @@ def test_checkpoint_roundtrip_across_tiers(tmp_path, rng, restore_tier):
     before = _read_all(srv)
     srv2 = _mk(restore_tier, hot_rows=16)
     restore_server(srv2, path)
-    # bit-identical regardless of pre-save residency or restore tiering
-    assert np.array_equal(_read_all(srv2), before)
     if restore_tier:
-        # residency reset cleanly: everything cold, re-promoted lazily
+        # residency reset cleanly: everything cold. Checked BEFORE the
+        # first read — a read's cold misses kick the (executor-run)
+        # maintenance worker, which starts re-promoting immediately
         for st in srv2.stores:
             assert (st.res.dev_row < 0).all()
             assert (st.res.row_slot < 0).all()
             assert st.res.alloc.num_free(0) == st.res.hot_rows
+    # bit-identical regardless of pre-save residency or restore tiering
+    assert np.array_equal(_read_all(srv2), before)
+    if restore_tier:
         # lazy re-promotion works and is value-invisible
         srv2.tier.promote_keys(np.arange(0, 64))
         assert np.array_equal(_read_all(srv2), before)

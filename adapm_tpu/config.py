@@ -166,9 +166,22 @@ class SystemOptions:
     # trace output path (default: <stats_out or cwd>/spans.<rank>.trace.json)
     trace_spans_out: Optional[str] = None
     # faulthandler crash dumps with a per-rank file (+ last-open-span
-    # breadcrumb when trace_spans is on) — attributes this image's
-    # intermittent XLA-CPU hard aborts (CHANGES.md r6). Default on.
+    # breadcrumb when trace_spans is on, + the executor flight-recorder
+    # ring file) — attributes this image's intermittent XLA-CPU hard
+    # aborts (CHANGES.md r6). Default on.
     crash_dumps: bool = True
+    # request-flight tracing (obs/flight.py, docs/OBSERVABILITY.md):
+    # per-request trace ids minted at ServeSession.lookup /
+    # Worker.pull|push, carried through admission -> batch -> executor
+    # program -> reply and exported as Perfetto FLOW events, plus the
+    # queue/batch_wait/dispatch/device breakdown histograms and the
+    # push-to-servable freshness probe. Default off — same skip-wrapper
+    # discipline as trace_spans: off costs one `is None` check per op
+    # and registers zero flight.* metrics.
+    trace_flight: bool = False
+    # flight trace output path
+    # (default: <stats_out or cwd>/flight.<rank>.trace.json)
+    trace_flight_out: Optional[str] = None
 
     # -- online serving plane (sys.serve.*; adapm_tpu/serve,
     #    docs/SERVING.md). Knob ranges are validated by validate_serve()
@@ -186,6 +199,14 @@ class SystemOptions:
     # default per-lookup deadline in ms (0 = none); expired requests
     # are shed loudly (DeadlineExceededError), never parked
     serve_deadline_ms: float = 0.0
+    # tail-latency SLO target in ms (0 = off, the default). When set, a
+    # closed-loop controller (obs/slo.py) observes the serve P99 from
+    # the latency histogram and adapts the effective max_wait_us —
+    # bounded, with hysteresis — so tails track the target instead of
+    # the hand-tuned static window. When unset, serve behavior is
+    # IDENTICAL to the static-knob path (no controller exists).
+    # Requires --sys.metrics (the controller reads the histogram).
+    serve_slo_ms: float = 0.0
 
     # -- sampling (--sampling.*)
     sampling_scheme: str = "local"   # naive | preloc | pool | local
@@ -216,6 +237,15 @@ class SystemOptions:
             raise ValueError(
                 f"--sys.serve.deadline_ms must be >= 0 "
                 f"(got {self.serve_deadline_ms}; 0 = no deadline)")
+        if self.serve_slo_ms < 0:
+            raise ValueError(
+                f"--sys.serve.slo_ms must be >= 0 "
+                f"(got {self.serve_slo_ms}; 0 = no SLO controller)")
+        if self.serve_slo_ms > 0 and not self.metrics:
+            raise ValueError(
+                "--sys.serve.slo_ms requires --sys.metrics: the SLO "
+                "controller observes the serve P99 from the "
+                "serve.latency_s histogram and is blind without it")
         if self.tier and self.tier_hot_rows < 8:
             raise ValueError(
                 f"--sys.tier.hot_rows must be >= 8 (got "
@@ -314,6 +344,10 @@ class SystemOptions:
                        dest="sys_trace_spans_out", default=None)
         g.add_argument("--sys.crash_dumps", dest="sys_crash_dumps",
                        type=int, default=1)
+        g.add_argument("--sys.trace.flight", dest="sys_trace_flight",
+                       type=int, default=0)
+        g.add_argument("--sys.trace.flight_out",
+                       dest="sys_trace_flight_out", default=None)
         g.add_argument("--sys.serve.max_batch", dest="sys_serve_max_batch",
                        type=int, default=64)
         g.add_argument("--sys.serve.max_wait_us",
@@ -323,6 +357,8 @@ class SystemOptions:
         g.add_argument("--sys.serve.deadline_ms",
                        dest="sys_serve_deadline_ms", type=float,
                        default=0.0)
+        g.add_argument("--sys.serve.slo_ms", dest="sys_serve_slo_ms",
+                       type=float, default=0.0)
         s = parser.add_argument_group("sampling")
         s.add_argument("--sampling.scheme", dest="sampling_scheme",
                        default="local",
@@ -376,10 +412,13 @@ class SystemOptions:
             trace_spans=bool(args.sys_trace_spans),
             trace_spans_out=args.sys_trace_spans_out,
             crash_dumps=bool(args.sys_crash_dumps),
+            trace_flight=bool(args.sys_trace_flight),
+            trace_flight_out=args.sys_trace_flight_out,
             serve_max_batch=args.sys_serve_max_batch,
             serve_max_wait_us=args.sys_serve_max_wait_us,
             serve_queue=args.sys_serve_queue,
             serve_deadline_ms=args.sys_serve_deadline_ms,
+            serve_slo_ms=args.sys_serve_slo_ms,
             sampling_scheme=args.sampling_scheme,
             sampling_reuse_factor=args.sampling_reuse,
             sampling_pool_size=args.sampling_pool_size,

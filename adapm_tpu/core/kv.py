@@ -118,18 +118,37 @@ class Server:
         _obs_metrics.set_global_registry(self.obs)
         self.spans = None
         self.crash_dump_path = None
-        bc_path = None
+        bc_path = ring_path = None
         if self.opts.crash_dumps:
             from ..obs.crash import enable_crash_dumps
             try:
-                self.crash_dump_path, bc_path = enable_crash_dumps(
-                    self.pid, self.opts.stats_out)
+                self.crash_dump_path, bc_path, ring_path = \
+                    enable_crash_dumps(self.pid, self.opts.stats_out)
             except OSError:  # unwritable dump dir must not block startup
-                bc_path = None
+                bc_path = ring_path = None
         if self.opts.trace_spans:
             from ..obs.spans import SpanTracer
             self.spans = SpanTracer(rank=self.pid,
                                     breadcrumb_path=bc_path)
+        # request-flight tracing (ISSUE 7 tentpole; obs/flight.py):
+        # per-request causal traces across admission -> batch ->
+        # executor program -> reply, exported as Perfetto flow events.
+        # Default off — when None every instrumented site pays one
+        # `is None` check (the r7 skip-wrapper discipline) and the
+        # registry holds zero flight.* names.
+        self.flight = None
+        if self.opts.trace_flight:
+            from ..obs.flight import FlightTracer
+            self.flight = FlightTracer(registry=self.obs, rank=self.pid)
+        # executor flight-recorder ring (rides --sys.crash_dumps): the
+        # last K executor programs per stream, mirrored into a ring
+        # file, so a hard abort's post-mortem says what was in flight.
+        # Per PROGRAM — independent of --sys.trace.flight, never on the
+        # per-op hot path.
+        self.flight_recorder = None
+        if self.opts.crash_dumps:
+            from ..obs.flight import FlightRecorder
+            self.flight_recorder = FlightRecorder(path=ring_path)
         # unified async executor (ISSUE 6 tentpole; adapm_tpu/exec,
         # docs/EXECUTOR.md): THE ordered-stream dispatch plane under
         # sync rounds, prefetch staging, tier maintenance, serve
@@ -139,7 +158,8 @@ class Server:
         from ..exec import AsyncExecutor
         self.exec = AsyncExecutor(registry=self.obs,
                                   workers=self.opts.exec_workers,
-                                  single_stream=self.opts.exec_single_stream)
+                                  single_stream=self.opts.exec_single_stream,
+                                  recorder=self.flight_recorder)
 
         # kv-layer metrics: per-op latency histograms live on the
         # workers (kv.pull_s/push_s/set_s, shared); registry-side extras:
@@ -1259,8 +1279,11 @@ class Server:
         self.sync.close()
         self.write_stats()
         self.write_trace()
+        self.write_flight_trace()
         if self.spans is not None:
             self.spans.close()
+        if self.flight_recorder is not None:
+            self.flight_recorder.close()
         from ..obs import metrics as _obs_metrics
         _obs_metrics.clear_global_registry(self.obs)
         if self.glob is not None:
@@ -1339,7 +1362,7 @@ class Server:
     # metrics_snapshot() — the schema-stability contract tests pin
     _SNAPSHOT_SECTIONS = ("kv", "prefetch", "plan_cache", "staging",
                           "sync", "pm", "collective", "fused", "spans",
-                          "serve", "tier", "exec")
+                          "serve", "tier", "exec", "flight", "slo")
 
     def metrics_snapshot(self, drain_device: bool = True) -> Dict:
         """One structured, JSON-serializable telemetry dict for this
@@ -1378,8 +1401,22 @@ class Server:
         histogram (`exec.dispatch_wait_s`), program counters, and the
         `exec.overlap_fraction` gauge (fraction of busy executor wall
         time where >= 2 streams ran simultaneously — the
-        transfer/compute-overlap measure)."""
-        out: Dict = {"schema_version": 5,
+        transfer/compute-overlap measure).
+
+        schema_version 6 (PR 7): new always-present `flight` and `slo`
+        sections. `flight` — request-flight tracing (obs/flight.py):
+        the per-request breakdown histograms (`queue_s` /
+        `batch_wait_s` / `dispatch_s` / `device_s`), the freshness
+        probe (`freshness_s`), trace/program counters, the tracer's
+        minted/complete/dropped stats, and the executor
+        flight-recorder summary (`recorder`, present whenever
+        `--sys.crash_dumps` is on). `{}` when `--sys.trace.flight` is
+        off and crash dumps are off too. `slo` — the closed-loop
+        tail-latency controller (obs/slo.py, `--sys.serve.slo_ms`):
+        target/effective-window/P99 gauges, tick/adjustment counters,
+        and the bounded recent-adjustment log; `{}` when no SLO target
+        is set."""
+        out: Dict = {"schema_version": 6,
                      "metrics_enabled": bool(self.obs.enabled)}
         for s in self._SNAPSHOT_SECTIONS:
             out[s] = {}
@@ -1427,6 +1464,13 @@ class Server:
         # executor occupancy/overlap summary rides with the registry's
         # exec.* gauges (same numbers, one locked read)
         out["exec"].update(self.exec.stats())
+        if self.flight is not None:
+            out["flight"].update(self.flight.stats())
+        if self.flight_recorder is not None:
+            out["flight"]["recorder"] = self.flight_recorder.summary()
+        if self._serve_plane is not None and \
+                self._serve_plane.slo is not None:
+            out["slo"].update(self._serve_plane.slo.report())
         if serve_ready is not None:
             # readiness detail rides with the serve.* gauges: dead peers
             # (Server.dead_nodes — detection-only), queue depth/bound,
@@ -1445,6 +1489,19 @@ class Server:
             self.opts.stats_out or ".",
             f"spans.{self.pid}.trace.json")
         return self.spans.export(path)
+
+    def write_flight_trace(self) -> Optional[str]:
+        """Export the request-flight trace (Perfetto flow-event JSON;
+        docs/OBSERVABILITY.md "Follow one request") when
+        --sys.trace.flight is on; returns the path. Called by shutdown;
+        callable earlier for a mid-run export."""
+        if self.flight is None:
+            return None
+        import os
+        path = self.opts.trace_flight_out or os.path.join(
+            self.opts.stats_out or ".",
+            f"flight.{self.pid}.trace.json")
+        return self.flight.export(path)
 
     def wait_sync(self) -> None:
         """Act on all signalled intents and complete a full sync round
@@ -1581,10 +1638,13 @@ class Worker:
         return list(self._write_futs)
 
     def _instrumented(self, name: str, h, impl, *args):
-        """Latency-histogram + span bracket for a worker op; degrades to
-        a plain call when metrics AND spans are both off."""
+        """Latency-histogram + span + flight bracket for a worker op;
+        degrades to a plain call when metrics, spans, and flight
+        tracing are all off (the skip-wrapper discipline: each disabled
+        layer costs one `is None` check here)."""
         sp = self.server.spans
-        if h is None and sp is None:
+        fl = self.server.flight
+        if h is None and sp is None and fl is None:
             return impl(*args)
         t0 = _time.perf_counter()
         tok = sp.begin(name) if sp is not None else None
@@ -1595,6 +1655,10 @@ class Worker:
                 h.observe(_time.perf_counter() - t0)
             if tok is not None:
                 sp.end(name, tok)
+            if fl is not None:
+                # a plain Worker op is a single-segment flight: one
+                # minted id, one slice on the caller's thread
+                fl.record_op(name, t0)
 
     def _cached_push_routes(self, keys: np.ndarray, tv: int, is_set: bool):
         """Route skeleton for push/set through the plan cache (values are
@@ -1703,6 +1767,12 @@ class Worker:
         keys = self._keys(keys)
         vals = np.asarray(vals, dtype=np.float32)
         srv = self.server
+        probe = None
+        if srv.flight is not None:
+            # event-to-servable freshness probe (sampled): push wall
+            # time -> first serve read of the key (obs/flight.py);
+            # marked visible under the lock once the scatter enqueues
+            probe = srv.flight.freshness.note_push(keys)
         after = self._live_write_futs() if srv.glob is not None else ()
         plan, tv = None, -1
         if srv.opts.optimistic_routing:
@@ -1716,6 +1786,8 @@ class Worker:
             n_remote, futs = srv._push(keys, vals, self.shard,
                                        is_set=False, after=after,
                                        plan=plan)
+            if probe is not None:
+                srv.flight.freshness.push_visible(probe)
         self.stats["push_ops"] += 1
         self.stats["push_params"] += len(keys)
         self.stats["push_params_local"] += len(keys) - n_remote

@@ -159,8 +159,13 @@ class AsyncExecutor:
     """
 
     def __init__(self, registry=None, workers: int = 4,
-                 single_stream: bool = False, name: str = "exec"):
+                 single_stream: bool = False, name: str = "exec",
+                 recorder=None):
         self.name = name
+        # optional flight recorder (obs/flight.py, rides
+        # --sys.crash_dumps): one ring append + pwrite per PROGRAM —
+        # never per Pull/Push op, so the hot path never sees it
+        self.recorder = recorder
         self.max_workers = 1 if single_stream else max(1, int(workers))
         self.single_stream = bool(single_stream)
         self._cond = threading.Condition()
@@ -434,7 +439,9 @@ class AsyncExecutor:
                 self._stream_enter(st)
                 self._started += 1
             self._c_programs.inc()
-            self._h_wait.observe(time.monotonic() - prog.t_submit)
+            t_run = time.monotonic()
+            wait_s = t_run - prog.t_submit
+            self._h_wait.observe(wait_s)
             result = None
             error = None
             try:
@@ -445,6 +452,11 @@ class AsyncExecutor:
                 error = e
                 alog(f"[exec] program {prog.label!r} on stream "
                      f"{st.name!r} failed: {type(e).__name__}: {e}")
+            rec = self.recorder
+            if rec is not None:
+                rec.record(st.name, prog.label, prog.coalesce_key,
+                           wait_s, time.monotonic() - t_run,
+                           failed=error is not None)
             with self._cond:
                 self._stream_exit(st)
                 self._finished += 1

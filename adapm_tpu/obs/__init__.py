@@ -11,6 +11,15 @@ One layer every subsystem reports into (see docs/OBSERVABILITY.md):
     (default off).
   - `crash.enable_crash_dumps`: faulthandler with a per-rank dump file,
     plus a last-open-span breadcrumb so an abort is attributable.
+  - `flight.FlightTracer`: per-request causal traces across admission
+    -> batch -> executor -> device, exported as Perfetto FLOW events —
+    one served lookup renders as one connected chain.
+    `--sys.trace.flight` (default off). `flight.FlightRecorder`: the
+    bounded per-stream ring of the last executor programs, mirrored to
+    a ring file for abort post-mortems (rides `--sys.crash_dumps`).
+  - `slo.SLOController`: the closed-loop tail-latency controller that
+    adapts the serve micro-batch window toward `--sys.serve.slo_ms`.
+    Imported ONLY when a target is set.
   - `reporter.Reporter`: optional periodic one-line summary
     (`--sys.metrics.report`). Imported ONLY when enabled — the hot path
     never pays for it.

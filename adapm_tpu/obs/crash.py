@@ -10,6 +10,11 @@ the unmodified seed). A Python traceback never appears for those, so:
   - span begins overwrite a last-open-span breadcrumb file
     (obs/spans.py) when `--sys.trace.spans` is on, naming the phase the
     process died inside.
+  - the executor flight-recorder ring (obs/flight.py FlightRecorder)
+    mirrors the last K executor programs — stream, label, coalesce key,
+    wait/run times — into a fixed-size ring file next to the dump, one
+    `pwrite` per PROGRAM, so the abort's post-mortem also says what was
+    in flight when the process died.
 
 Dump files go to `--sys.stats.out` when set, else the system temp dir;
 they are tiny, overwritten per process, and cost nothing until a crash.
@@ -33,14 +38,17 @@ def crash_dir(stats_out: Optional[str]) -> str:
 
 
 def enable_crash_dumps(rank: int,
-                       stats_out: Optional[str]) -> Tuple[str, str]:
+                       stats_out: Optional[str]) -> Tuple[str, str, str]:
     """Enable faulthandler into a per-rank dump file; returns
-    (dump_path, breadcrumb_path). The breadcrumb file is only written
-    when span tracing is on (SpanTracer owns that fd)."""
+    (dump_path, breadcrumb_path, flight_ring_path). The breadcrumb file
+    is only written when span tracing is on (SpanTracer owns that fd);
+    the flight-ring file is written by the executor's FlightRecorder
+    (obs/flight.py, one pwrite per program)."""
     global _dump_file
     d = crash_dir(stats_out)
     dump_path = os.path.join(d, f"adapm_crash.{rank}.{os.getpid()}.log")
     bc_path = os.path.join(d, f"adapm_breadcrumb.{rank}.{os.getpid()}.txt")
+    ring_path = os.path.join(d, f"adapm_flightring.{rank}.{os.getpid()}.log")
     if _dump_file is not None:
         try:
             _dump_file.close()
@@ -48,4 +56,4 @@ def enable_crash_dumps(rank: int,
             pass
     _dump_file = open(dump_path, "w")
     faulthandler.enable(file=_dump_file, all_threads=True)
-    return dump_path, bc_path
+    return dump_path, bc_path, ring_path

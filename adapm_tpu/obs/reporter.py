@@ -8,16 +8,34 @@ import time.
 
 The report reads the REGISTRY only (no fused locstat drain, no device
 sync): a line every N seconds must not force device readbacks the way a
-full `Server.metrics_snapshot()` may."""
+full `Server.metrics_snapshot()` may.
+
+Line format (STABLE — tests/test_flight.py::test_reporter_line_format
+pins it; tools that grep logs for these fields may rely on it):
+space-separated `field=value` groups, each emitted only when its
+subsystem has activity, always in this order:
+
+    pull=<n> avg=<ms>ms  push=<n> avg=<ms>ms   kv op counts + mean
+    staged_hit=<ratio>                         prefetch hit rate
+    plan_hit=<ratio>                           plan-cache hit rate
+    rounds=<n> reloc=<n> repl=<n>              sync activity
+    serve=<n> p50=<ms>ms p99=<ms>ms            lookups + latency tail
+    overlap=<ratio>                            exec overlap_fraction
+    hot_hit=<ratio>                            tier hot-hit rate
+
+Ratios are 2-decimal, latencies 2-decimal milliseconds."""
 from __future__ import annotations
 
 import threading
 from typing import Optional
 
+from .metrics import hist_percentile
+
 
 def _fmt(snap: dict) -> str:
     """Compress a registry snapshot into one line of the load-bearing
-    numbers; unknown sections degrade to counts, never crash."""
+    numbers (format contract in the module docstring); unknown sections
+    degrade to counts, never crash."""
     parts = []
     kv = snap.get("kv", {})
     for h in ("pull_s", "push_s"):
@@ -38,6 +56,22 @@ def _fmt(snap: dict) -> str:
         parts.append(f"rounds={sy['rounds']} "
                      f"reloc={sy.get('relocations', 0)} "
                      f"repl={sy.get('replicas_created', 0)}")
+    # serving plane: lookup count + the latency tail the SLO lives on
+    sv = snap.get("serve", {})
+    lat = sv.get("latency_s")
+    if isinstance(lat, dict) and lat.get("count"):
+        parts.append(
+            f"serve={sv.get('lookups_total', lat['count'])} "
+            f"p50={hist_percentile(lat, 0.50) * 1e3:.2f}ms "
+            f"p99={hist_percentile(lat, 0.99) * 1e3:.2f}ms")
+    # executor: cross-stream overlap once any program has run
+    ex = snap.get("exec", {})
+    if ex.get("programs_total"):
+        parts.append(f"overlap={ex.get('overlap_fraction', 0.0):.2f}")
+    # tiered storage: hot-hit rate once any tiered gather ran
+    tr = snap.get("tier", {})
+    if tr.get("hot_hits", 0) or tr.get("cold_hits", 0):
+        parts.append(f"hot_hit={tr.get('hot_hit_rate', 0.0):.2f}")
     return " ".join(parts) or "no activity yet"
 
 

@@ -54,12 +54,22 @@ class ServePlane:
         self.batcher = LookupBatcher(server, opts, self.queue, shard=shard)
         self.health = HealthMonitor(self, max_age_s=dead_node_max_age_s,
                                     dead_nodes_fn=dead_nodes_fn)
+        # SLO autopilot (obs/slo.py, ISSUE 7): only with a target set —
+        # unset, no controller exists and the static max_wait_us knob
+        # path is untouched (the module is not even imported)
+        self.slo = None
+        if opts.serve_slo_ms > 0:
+            from ..obs.slo import SLOController
+            self.slo = SLOController(server, self.batcher,
+                                     target_ms=opts.serve_slo_ms)
         server._serve_plane = self
         if start:
             self.start()
 
     def start(self) -> None:
         self.batcher.start()
+        if self.slo is not None:
+            self.slo.start()
 
     def session(self, worker=None) -> ServeSession:
         """A client handle (one per client thread; cheap). Pass the
@@ -69,6 +79,11 @@ class ServePlane:
     def close(self) -> None:
         """Stop the dispatcher and fail-stop queued requests. Idempotent;
         also called by `Server.shutdown()`."""
+        if self.slo is not None:
+            # stop the control loop before the dispatcher: a tick that
+            # already sits queued on the `slo` stream sees _closed and
+            # exits (executor close cancels it outright)
+            self.slo.close()
         self.batcher.stop()
         if getattr(self.server, "_serve_plane", None) is self:
             self.server._serve_plane = None

@@ -55,11 +55,15 @@ class LookupRequest:
     ordering futures, a deadline, and the delivery rendezvous."""
 
     __slots__ = ("keys", "after", "deadline", "t0", "result", "error",
-                 "_state", "_lock", "_done")
+                 "trace", "_state", "_lock", "_done")
 
     def __init__(self, keys: np.ndarray, after: Sequence = (),
-                 deadline_s: Optional[float] = None):
+                 deadline_s: Optional[float] = None, trace=None):
         self.keys = keys
+        # request-flight trace context (obs/flight.py FlightTrace),
+        # minted by the session when --sys.trace.flight is on; None —
+        # the common case — costs nothing anywhere below
+        self.trace = trace
         # outstanding cross-process write futures of the client's worker:
         # the coalesced pull is ordered after them, so a client that also
         # pushes reads its own writes (same `after` contract as
@@ -88,6 +92,10 @@ class LookupRequest:
             if self._state != _PENDING:
                 return False
             self._state = _CLAIMED
+            if self.trace is not None:
+                # end of queue residence: the flight's queue_s segment
+                # closes here, batch_wait_s starts
+                self.trace.t_claim = time.perf_counter()
             return True
 
     def try_shed(self) -> bool:

@@ -58,6 +58,12 @@ class LookupBatcher:
         # pools are one global sharded array, so any shard's rows are
         # one gather away in a single process)
         self.shard = int(shard)
+        # the EFFECTIVE micro-batch window: initialized from the static
+        # knob and — only when --sys.serve.slo_ms is set — adapted by
+        # the SLO controller (obs/slo.py) so tails track the target.
+        # With no SLO target nothing ever writes it, so the static-knob
+        # path behaves exactly as before
+        self.max_wait_us = int(opts.serve_max_wait_us)
         self._running = False
         reg = server.obs
         # shared=True: a plane rebuilt on the same server reuses the
@@ -128,8 +134,10 @@ class LookupBatcher:
         first request — that linger is the coalescing lever and counts
         as genuine stream-busy time."""
         max_batch = self.opts.serve_max_batch
-        max_wait_s = self.opts.serve_max_wait_us * 1e-6
         while True:
+            # re-read per batch: the SLO controller adapts max_wait_us
+            # between batches and the next window must honor it
+            max_wait_s = self.max_wait_us * 1e-6
             reqs = self.queue.take(max_batch, max_wait_s, block=False)
             if not reqs:
                 return  # empty (or closed): park until the next kick
@@ -145,6 +153,9 @@ class LookupBatcher:
 
     def _serve_batch(self, reqs: List[LookupRequest]) -> None:
         srv = self.server
+        fl = srv.flight
+        t_dispatch = time.perf_counter()  # batch window closes, the
+        # coalesced lookup starts (flight.batch -> flight.program edge)
         self.c_batches.inc()
         self.h_batch.observe(float(len(reqs)))
         if len(reqs) == 1:
@@ -161,7 +172,7 @@ class LookupBatcher:
             srv.tier.note_serve(union)
         after = tuple(f for r in reqs for f in r.after)
         try:
-            flat = self._lookup_union(union, after)
+            flat, t_enqueued = self._lookup_union(union, after)
         except BaseException as e:  # noqa: BLE001 — fail every waiter
             for r in reqs:
                 r.fail(e)
@@ -173,18 +184,36 @@ class LookupBatcher:
         offs_u = _offsets(lens_u)
         self.c_keys_unique.inc(len(union))
         now = time.perf_counter()
+        if fl is not None:
+            # stamp the program timestamps on every member trace and
+            # record the batch-membership slices BEFORE delivering:
+            # deliver wakes the client, whose finish_lookup closes the
+            # flow and must see a fully-stamped trace
+            fl.record_serve_batch(
+                [r.trace for r in reqs if r.trace is not None],
+                t_dispatch, t_enqueued, now, n_requests=len(reqs),
+                n_keys=len(allk), n_unique=len(union))
+            # freshness probe: this union is a servable read of any
+            # probed key whose push was enqueued before this gather
+            # (obs/flight.py; t_enqueued orders the two)
+            fl.freshness.note_read(union, t_enqueued)
         for r in reqs:
             pos = np.searchsorted(union, r.keys)
+            if r.trace is not None:
+                r.trace.t_deliver = time.perf_counter()
             r.deliver(_select_flat(flat, offs_u, lens_u, pos))
             self.c_lookups.inc()
             self.c_keys.inc(len(r.keys))
             self.h_latency.observe(now - r.t0)
 
-    def _lookup_union(self, keys: np.ndarray, after) -> np.ndarray:
+    def _lookup_union(self, keys: np.ndarray, after):
         """One coalesced pull of the (unique, sorted) union batch — the
         `Worker._pull_op` sequence minus per-worker staging: optimistic
         plan via the shared routing-plan cache, topology_version
-        revalidation under the lock, `Server._pull` dispatch."""
+        revalidation under the lock, `Server._pull` dispatch. Returns
+        `(flat, t_enqueued)`: the perf_counter stamp taken right after
+        the device gather programs are ENQUEUED (the flight breakdown's
+        dispatch/device split; assembly below it blocks on the device)."""
         srv = self.server
         with srv._span("serve.lookup"):
             plan, tv = None, -1
@@ -198,4 +227,8 @@ class LookupBatcher:
                     plan = None  # topology moved underneath us: re-plan
                 groups, _, remote = srv._pull(keys, self.shard,
                                               after=after, plan=plan)
-            return srv._assemble_flat(keys, groups, remote=remote)
+                # stamped under the lock so it totally orders against
+                # FreshnessProbe.push_visible stamps (same lock)
+                t_enqueued = time.perf_counter()
+            return (srv._assemble_flat(keys, groups, remote=remote),
+                    t_enqueued)

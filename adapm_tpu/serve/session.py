@@ -79,23 +79,43 @@ class ServeSession:
         after = ()
         if self.worker is not None and srv.glob is not None:
             after = tuple(self.worker._live_write_futs())
-        req = LookupRequest(keys, after=after, deadline_s=deadline_s)
-        self.plane.queue.submit(req)  # may raise ServeOverloadError
-        if not req.wait(deadline_s):
-            # deadline passed while we waited: shed if still unclaimed
-            if req.try_shed():
-                self.plane.queue.c_shed.inc()
-                raise DeadlineExceededError(
-                    f"lookup deadline ({deadline_ms} ms) expired before "
-                    f"a micro-batch claimed the request "
-                    f"(queue depth {self.plane.queue.depth()})")
-            # claimed: an in-flight batch will deliver — bounded grace
-            if not req.wait(_CLAIMED_GRACE_S):
-                raise RuntimeError(
-                    "serve dispatcher failed to deliver a claimed "
-                    f"request within {_CLAIMED_GRACE_S}s — wedged "
-                    "dispatcher (fail-stop, docs/failure_handling.md)")
-        flat = req.take_result()  # raises the shed/close error if any
+        # request-flight tracing (--sys.trace.flight, obs/flight.py):
+        # mint the per-request trace id here — the causal chain's start.
+        # The id rides the queue entry, is stamped by the batcher when a
+        # micro-batch claims and dispatches it, and closes below at
+        # reply time; off costs exactly this one `is None` check
+        fl = srv.flight
+        tr = fl.mint() if fl is not None else None
+        req = LookupRequest(keys, after=after, deadline_s=deadline_s,
+                            trace=tr)
+        try:
+            self.plane.queue.submit(req)  # may raise ServeOverloadError
+            if not req.wait(deadline_s):
+                # deadline passed while we waited: shed if still
+                # unclaimed
+                if req.try_shed():
+                    self.plane.queue.c_shed.inc()
+                    raise DeadlineExceededError(
+                        f"lookup deadline ({deadline_ms} ms) expired "
+                        f"before a micro-batch claimed the request "
+                        f"(queue depth {self.plane.queue.depth()})")
+                # claimed: an in-flight batch will deliver — bounded
+                # grace
+                if not req.wait(_CLAIMED_GRACE_S):
+                    raise RuntimeError(
+                        "serve dispatcher failed to deliver a claimed "
+                        f"request within {_CLAIMED_GRACE_S}s — wedged "
+                        "dispatcher (fail-stop, "
+                        "docs/failure_handling.md)")
+            flat = req.take_result()  # raises the shed/close error
+        except BaseException:
+            if fl is not None:
+                # shed/overload/close: a terminal lookup slice records
+                # the abandoned flight so no trace dangles silently
+                fl.finish_lookup(tr, ok=False)
+            raise
+        if fl is not None:
+            fl.finish_lookup(tr, ok=True)
         if out is not None:
             # reshape(-1) on a non-contiguous view would COPY and the
             # caller's buffer would silently stay unfilled; a too-small

@@ -444,8 +444,62 @@ def bench_serve(E=20_000, vlen=32, clients=32, lookups_per_client=40,
         except (DeadlineExceededError, ServeOverloadError):
             pass
     shed = srv.obs.find("serve.shed_total").value - shed_before
+
+    # -- SLO autopilot + per-request breakdown exemplar (ISSUE 7) -----
+    # Rebuild the plane with flight tracing attached, an SLO target,
+    # and a deliberately oversized micro-batch window (4x the target):
+    # the artifact then carries the controller's convergence
+    # (wait_us_adjustments, achieved P99 vs target) and one sampled
+    # request's queue/batch/dispatch/device split — where the
+    # milliseconds actually went, not just totals.
+    _progress("serve phase: slo autopilot segment")
+    plane.close()
+    from adapm_tpu.obs.flight import FlightTracer
+    srv.flight = FlightTracer(registry=srv.obs, rank=srv.pid)
+    slo_target_ms = 20.0
+    srv.opts.serve_slo_ms = slo_target_ms
+    srv.opts.serve_max_wait_us = int(slo_target_ms * 4e3)
+    plane2 = ServePlane(srv)
+    h_lat = srv.obs.find("serve.latency_s")
+    stop = threading.Event()
+    errs2: list = []
+
+    def slo_client(ci):
+        try:
+            sess = plane2.session()
+            crng = np.random.default_rng(1000 + ci)
+            while not stop.is_set():
+                sess.lookup(_skewed_keys(crng, E, B))
+        except BaseException as e:  # noqa: BLE001
+            errs2.append(e)
+
+    slo_threads = [threading.Thread(target=slo_client, args=(ci,))
+                   for ci in range(8)]
+    for t in slo_threads:
+        t.start()
+    time.sleep(1.5)             # controller walks the window down
+    lat_a = h_lat.snap()        # trailing window: post-convergence P99
+    time.sleep(1.5)
+    lat_b = h_lat.snap()
+    stop.set()
+    for t in slo_threads:
+        t.join(timeout=60)
+    assert not errs2, errs2[:3]
+    win = {"count": lat_b["count"] - lat_a["count"],
+           "bounds": lat_b["bounds"],
+           "buckets": [a - b for a, b in zip(lat_b["buckets"],
+                                             lat_a["buckets"])]}
+    achieved_p99_ms = round(1e3 * hist_percentile(win, 0.99), 3)
+    slo_rep = plane2.slo.report()
+    exemplar = srv.flight.exemplar()
+    # snapshot while the plane is live: serve.readiness and the slo
+    # section are filled from the open plane, close() empties them
+    snap = srv.metrics_snapshot()
+    plane2.close()
     _progress(f"serve phase: {qps:.0f} qps coalesced vs {seq_qps:.0f} "
-              f"sequential, {shed} shed under overload")
+              f"sequential, {shed} shed under overload; slo p99 "
+              f"{achieved_p99_ms:.1f} ms vs {slo_target_ms:.0f} ms "
+              f"target in {slo_rep['adjustments']} adjustments")
     out = {"clients": clients,
            "lookups": total,
            "keys_per_lookup": B,
@@ -458,7 +512,23 @@ def bench_serve(E=20_000, vlen=32, clients=32, lookups_per_client=40,
            "batch_size_avg": round(bsz["avg"], 2),
            "batch_size_max": bsz["max"],
            "shed_total_overload": int(shed),
-           "metrics": srv.metrics_snapshot()}
+           # the SLO autopilot's convergence record (obs/slo.py) — the
+           # windowed P99 AFTER the controller settled vs the target,
+           # and every knob move it took to get there
+           "slo": {"target_ms": slo_target_ms,
+                   "achieved_p99_ms": achieved_p99_ms,
+                   "wait_us_adjustments": slo_rep["adjustments"],
+                   "initial_wait_us": int(slo_target_ms * 4e3),
+                   "final_wait_us": slo_rep["wait_us"],
+                   "recent_adjustments": slo_rep["recent_adjustments"]},
+           # one sampled request's queue/batch/dispatch/device split
+           # (ms) — where a lookup's time went (obs/flight.py)
+           "flight_exemplar": exemplar,
+           "metrics": snap}
+    # detach the tracer before shutdown: the exemplar + flight section
+    # are already in the artifact, and a shutdown export would drop a
+    # flight.<rank>.trace.json into the working directory
+    srv.flight = None
     srv.shutdown()
     return out
 

@@ -75,6 +75,20 @@ def main() -> int:
     names = srv.obs.names()
     print(f"[overhead-check] registry catalog: {len(names)} metrics, "
           f"duplicate-name check passed (enforced at registration)")
+    # ISSUE 7: request-flight tracing is compiled in but DEFAULT OFF —
+    # the probe loop below therefore times the hot path with the flight
+    # branch present (one `is None` check in Worker._instrumented), and
+    # the same budget guard proves its default-off cost is nil. Pin the
+    # default-off state structurally too: no tracer, zero flight.*
+    # metric names.
+    assert srv.flight is None, \
+        "flight tracing must be DEFAULT OFF (--sys.trace.flight 0)"
+    flight_names = [n for n in names if n.startswith("flight.")]
+    assert not flight_names, \
+        f"default-off flight tracing registered metrics: {flight_names}"
+    print("[overhead-check] flight tracing default-off: no tracer, "
+          "zero flight.* names; probe times the hot path with the "
+          "flight branch compiled in")
     saved = (w._h_pull, w._h_push, w._h_set, srv.sync._h_round)
     probe(w, batches, vals, 30)  # warm the jit caches
     # per-pair (off, on) timings back to back; the guard is the MEDIAN

@@ -34,5 +34,10 @@ python scripts/tier_residency_check.py
 # must keep up with the serialized single-stream fallback on a tiered
 # promotion-churn workload (median pairwise ratio; overlap_fraction > 0)
 python scripts/exec_overlap_check.py
+# SLO-autopilot guard (ISSUE 7): with --sys.serve.slo_ms set against an
+# oversized micro-batch window, the closed-loop controller must walk
+# max_wait_us DOWN and land the observed serve P99 within the tolerance
+# band of the target (median of trailing measurement windows)
+python scripts/slo_convergence_check.py
 python -m pytest tests/ -q "$@"
 echo "ALL TESTS PASSED"

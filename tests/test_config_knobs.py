@@ -172,6 +172,37 @@ def test_serve_knobs_rejected_at_parse_time():
         SystemOptions(serve_max_batch=-3).validate_serve()
 
 
+def test_flight_and_slo_knobs_round_trip_and_rejection():
+    """--sys.trace.flight / --sys.serve.slo_ms parse into the options
+    the flight tracer and SLO controller consume, and invalid
+    combinations fail loudly at parse time (ISSUE 7)."""
+    import argparse
+
+    import pytest
+
+    from adapm_tpu.config import SystemOptions
+    p = argparse.ArgumentParser()
+    SystemOptions.add_arguments(p)
+    dflt = SystemOptions.from_args(p.parse_args([]))
+    # both DEFAULT OFF: no tracer, no controller, static knob path
+    assert dflt.trace_flight is False and dflt.trace_flight_out is None
+    assert dflt.serve_slo_ms == 0.0
+    on = SystemOptions.from_args(p.parse_args(
+        ["--sys.trace.flight", "1",
+         "--sys.trace.flight_out", "/tmp/f.json",
+         "--sys.serve.slo_ms", "12.5"]))
+    assert on.trace_flight is True
+    assert on.trace_flight_out == "/tmp/f.json"
+    assert on.serve_slo_ms == 12.5
+    # negative target / controller without its histogram: rejected
+    with pytest.raises(ValueError):
+        SystemOptions.from_args(p.parse_args(
+            ["--sys.serve.slo_ms", "-1"]))
+    with pytest.raises(ValueError):
+        SystemOptions.from_args(p.parse_args(
+            ["--sys.serve.slo_ms", "10", "--sys.metrics", "0"]))
+
+
 def test_tier_knobs_round_trip_and_rejection():
     """--sys.tier.* parse into the options the TierManager consumes,
     and bad ranges fail loudly at parse time (ISSUE 5)."""

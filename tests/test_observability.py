@@ -201,10 +201,18 @@ def test_metrics_snapshot_schema_stable():
     # PR 4 serve section (the online serving plane's metrics +
     # readiness; {} until a ServePlane is attached); v4 = the PR 5 tier
     # section (tiered-storage hot-hit/promotion metrics; {} while
-    # --sys.tier is off)
-    assert snap["schema_version"] == 5 and snap["metrics_enabled"]
+    # --sys.tier is off); v6 = the PR 7 flight/slo sections
+    # (request-flight tracing + the SLO autopilot; flight carries only
+    # the crash-ride flight-recorder summary until --sys.trace.flight,
+    # slo is {} until --sys.serve.slo_ms)
+    assert snap["schema_version"] == 6 and snap["metrics_enabled"]
     assert snap["serve"] == {}  # no ServePlane on this server
     assert snap["tier"] == {}   # --sys.tier off on this server
+    assert snap["slo"] == {}    # no --sys.serve.slo_ms target set
+    # flight tracing is off, but the executor flight-recorder rides
+    # --sys.crash_dumps (default on): the section carries its summary
+    assert set(snap["flight"]) == {"recorder"}
+    assert snap["flight"]["recorder"]["programs_recorded"] >= 0
     for sec in srv._SNAPSHOT_SECTIONS:
         assert isinstance(snap[sec], dict), sec
     # v2 sync surface: shipped vs considered + table-occupancy gauges

@@ -51,6 +51,18 @@ class SystemOptions:
     # full one. Default on; 0 is the kill switch (re-sync every
     # intent-live replica every round, the pre-PR-3 behavior).
     sync_dirty_only: bool = True
+    # delta compression for sync rounds (ISSUE 8; store.py
+    # _sync_replicas_compressed, docs/MEMORY.md contract): periodic
+    # rounds ship deltas in fp16 (half the bytes) or int8 + per-key
+    # fp16 scale (~quarter) with per-key error feedback — the
+    # quantization remainder parks in the replica's delta row and
+    # rides the next round, keeping the main copy's long-run sum
+    # unbiased; drop/quiesce flushes stay exact. "off" (default) is
+    # bit-identical to pre-compression behavior. Requires the dirty
+    # filter: compression marks synced replicas clean with a sub-grid
+    # residual parked, a bookkeeping step the full-resync path has no
+    # epoch state for (validate_serve rejects the combination).
+    sync_compress: str = "off"
 
     # -- collective sync data plane (parallel/collective.py): replica
     #    delta ship + fresh-value refresh ride device all-to-all exchanges
@@ -113,6 +125,13 @@ class SystemOptions:
     tier: bool = False
     # device-resident main rows per shard per length class
     tier_hot_rows: int = 65536
+    # cold-store at-rest format (ISSUE 8; tier/quant.py): fp32 keeps
+    # the bit-identity pin; fp16 halves host bytes/row (exact where
+    # the value is fp16-representable); int8 + per-row scale quarters
+    # them (exact on the row's int grid) — both otherwise follow the
+    # error-compensated contract in docs/MEMORY.md (demote parks the
+    # sub-grid remainder host-side; the next promote folds it back)
+    tier_cold_dtype: str = "fp32"
     # pin keys inside an active Intent window hot for the window
     tier_pin_intent: bool = True
     # demotion batch size / per-shard free-row headroom the maintenance
@@ -246,6 +265,33 @@ class SystemOptions:
                 "--sys.serve.slo_ms requires --sys.metrics: the SLO "
                 "controller observes the serve P99 from the "
                 "serve.latency_s histogram and is blind without it")
+        from .tier.quant import COLD_DTYPES, SYNC_COMPRESS_MODES
+        if self.tier_cold_dtype not in COLD_DTYPES:
+            raise ValueError(
+                f"--sys.tier.cold_dtype must be one of "
+                f"{'/'.join(COLD_DTYPES)} (got "
+                f"{self.tier_cold_dtype!r})")
+        if self.sync_compress not in SYNC_COMPRESS_MODES:
+            raise ValueError(
+                f"--sys.sync.compress must be one of "
+                f"{'/'.join(SYNC_COMPRESS_MODES)} (got "
+                f"{self.sync_compress!r})")
+        if self.sync_compress != "off" and not self.sync_dirty_only:
+            raise ValueError(
+                "--sys.sync.compress requires --sys.sync.dirty_only 1: "
+                "compressed rounds mark shipped replicas clean with a "
+                "sub-grid residual parked in the delta row — the "
+                "full-resync path re-ships every replica every round, "
+                "re-quantizing residuals that can never clear (bytes "
+                "and convergence both regress); turn the dirty filter "
+                "back on or turn compression off")
+        if self.sync_compress == "int8" and not self.metrics:
+            raise ValueError(
+                "--sys.sync.compress int8 requires --sys.metrics: the "
+                "int8 error-feedback loop is only auditable through "
+                "the sync.ef_residual_norm gauge — running a lossy "
+                "grid a quarter of fp32 wide with no metrics-visible "
+                "residual is a silent-quality-loss trap")
         if self.tier and self.tier_hot_rows < 8:
             raise ValueError(
                 f"--sys.tier.hot_rows must be >= 8 (got "
@@ -294,6 +340,8 @@ class SystemOptions:
                        type=float, default=0.0)
         g.add_argument("--sys.sync.dirty_only", dest="sys_sync_dirty_only",
                        type=int, default=1)
+        g.add_argument("--sys.sync.compress", dest="sys_sync_compress",
+                       default="off", choices=["off", "fp16", "int8"])
         g.add_argument("--sys.collective_sync", dest="sys_collective_sync",
                        type=int, default=0)
         g.add_argument("--sys.collective_bucket",
@@ -318,6 +366,9 @@ class SystemOptions:
         g.add_argument("--sys.tier", dest="sys_tier", type=int, default=0)
         g.add_argument("--sys.tier.hot_rows", dest="sys_tier_hot_rows",
                        type=int, default=65536)
+        g.add_argument("--sys.tier.cold_dtype",
+                       dest="sys_tier_cold_dtype", default="fp32",
+                       choices=["fp32", "fp16", "int8"])
         g.add_argument("--sys.tier.pin_intent",
                        dest="sys_tier_pin_intent", type=int, default=1)
         g.add_argument("--sys.tier.demote_batch",
@@ -387,6 +438,7 @@ class SystemOptions:
             sync_pause_ms=args.sys_sync_pause,
             sync_threshold=args.sys_sync_threshold,
             sync_dirty_only=bool(args.sys_sync_dirty_only),
+            sync_compress=args.sys_sync_compress,
             collective_sync=bool(args.sys_collective_sync),
             collective_bucket=args.sys_collective_bucket,
             collective_cadence=args.sys_collective_cadence,
@@ -399,6 +451,7 @@ class SystemOptions:
             plan_cache_entries=args.sys_plan_cache,
             tier=bool(args.sys_tier),
             tier_hot_rows=args.sys_tier_hot_rows,
+            tier_cold_dtype=args.sys_tier_cold_dtype,
             tier_pin_intent=bool(args.sys_tier_pin_intent),
             tier_demote_batch=args.sys_tier_demote_batch,
             exec_workers=args.sys_exec_workers,

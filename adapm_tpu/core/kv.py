@@ -191,7 +191,9 @@ class Server:
                 cache_slots_per_shard=cache_slots,
                 bucket_min=self.opts.remote_bucket_min,
                 tier_hot_rows=(self.opts.tier_hot_rows
-                               if self.opts.tier else 0)))
+                               if self.opts.tier else 0),
+                tier_cold_dtype=(self.opts.tier_cold_dtype
+                                 if self.opts.tier else "fp32")))
         self.ab = Addressbook(
             key_class, self.ctx.num_shards,
             [s.main_slots for s in self.stores],
@@ -921,15 +923,25 @@ class Server:
         return out
 
     def _sync_replicas(self, keys: np.ndarray, shards: np.ndarray,
-                       threshold: float = 0.0) -> None:
+                       threshold: float = 0.0,
+                       compress: bool = False) -> None:
         """Sync replicas given parallel (key, holder-shard) arrays.
         threshold > 0 leaves small-delta replicas out of the round
         (--sys.sync.threshold); drop/quiesce paths pass 0 so no pending
-        delta is ever lost. Under the lock this does only coordinate
-        revalidation and program ENQUEUE: the per-class device programs
-        are dispatched back-to-back (JAX dispatch is asynchronous), so
-        device execution overlaps the caller's classification of the
-        next channel instead of serializing behind the lock."""
+        delta is ever lost. compress=True applies the
+        --sys.sync.compress wire format (quantized deltas, EF residual
+        parked in the delta row — store._sync_replicas_compressed);
+        ONLY the periodic sync_channel rounds pass it. Drop and
+        quiesce flushes keep the default: a dropped replica's delta
+        row is freed, so a compressed flush there would LOSE its
+        parked residual — the exact flush is what bounds the
+        compression contract (docs/MEMORY.md). Under the lock this
+        does only coordinate revalidation and program ENQUEUE: the
+        per-class device programs are dispatched back-to-back (JAX
+        dispatch is asynchronous), so device execution overlaps the
+        caller's classification of the next channel instead of
+        serializing behind the lock."""
+        mode = self.opts.sync_compress if compress else "off"
         with self._lock:
             ab = self.ab
             karr = np.ascontiguousarray(keys, dtype=np.int64)
@@ -954,7 +966,8 @@ class Server:
                     if not ok.any():
                         continue
                 self.stores[cid].sync_replicas(ss, r_cs, o_sh, o_sl,
-                                               threshold=threshold)
+                                               threshold=threshold,
+                                               compress=mode)
 
     def _drop_replicas(self, keys: np.ndarray,
                        shards: np.ndarray) -> None:
@@ -1415,8 +1428,20 @@ class Server:
         tail-latency controller (obs/slo.py, `--sys.serve.slo_ms`):
         target/effective-window/P99 gauges, tick/adjustment counters,
         and the bounded recent-adjustment log; `{}` when no SLO target
-        is set."""
-        out: Dict = {"schema_version": 6,
+        is set.
+
+        schema_version 7 (PR 8): the compression plane's gauges
+        (ISSUE 8) — `sync.bytes_per_round` (wire bytes the most recent
+        round shipped in the --sys.sync.compress format),
+        `sync.bytes_shipped` / `sync.bytes_full_equiv` (cumulative
+        wire vs full-width-f32-equivalent bytes — their ratio IS the
+        compression factor), `sync.ef_residual_norm` (max-abs error-
+        feedback residual parked by the last compressed round), and in
+        the tier section `tier.cold_bytes_per_row` (actual host bytes
+        per cold row: dense store + scale column + parked residuals)
+        plus the `tier.ef_resid_rows` / `tier.ef_evicted` residual-map
+        health pair."""
+        out: Dict = {"schema_version": 7,
                      "metrics_enabled": bool(self.obs.enabled)}
         for s in self._SNAPSHOT_SECTIONS:
             out[s] = {}

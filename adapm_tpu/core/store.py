@@ -46,6 +46,12 @@ _GATE = dispatch_gate()
 # (mode="drop"), zero-filled by gathers (mode="fill").
 OOB = np.int32(2**31 - 2)
 
+# largest finite fp16 value: the compression wire formats clip to this
+# before any f16 cast (values/scales beyond it would cast to inf and
+# poison the EF loop with inf/NaN) — shared with tier/quant.py, whose
+# host transforms must match the device programs bitwise
+F16_MAX = 65504.0
+
 
 def bucket_size(n: int, minimum: int = 8) -> int:
     """Pad n up to a power of two (bounds the number of compiled variants)."""
@@ -132,6 +138,54 @@ def _sync_replicas(main, cache, delta, r_shard, r_cslot, o_shard, o_slot):
     cache = cache.at[r_shard, r_cslot].set(fresh, mode="drop")
     delta = delta.at[r_shard, r_cslot].set(jnp.zeros_like(fresh), mode="drop")
     return main, cache, delta
+
+
+@partial(jax.jit, donate_argnums=(0, 1, 2), static_argnames=("mode",))
+def _sync_replicas_compressed(main, cache, delta, r_shard, r_cslot,
+                              o_shard, o_slot, threshold, *, mode):
+    """_sync_replicas shipping QUANTIZED deltas with per-key error
+    feedback (--sys.sync.compress; ISSUE 8 tentpole, half b). The wire
+    transform is applied in-program: the owner merges what a receiver
+    would reconstruct from the fp16 / int8+fp16-scale payload — half /
+    quarter the future-DCN bytes per round — and the quantization
+    remainder is PARKED IN THE REPLICA'S DELTA ROW instead of zeroed
+    (the EF-SGD residual loop): it rides into the next shipped round,
+    so the main copy's long-run sum stays unbiased and a replica read
+    (cache + delta = fresh + residual) keeps read-your-writes to
+    within half a grid step. Sub-grid residuals of replicas that go
+    CLEAN are flushed exactly by the drop/quiesce paths, which bypass
+    compression (core/kv.py _sync_replicas). threshold composes like
+    _sync_replicas_thresholded: held rows keep their full delta.
+    Returns (main, cache, delta, max-abs parked residual) — the norm
+    feeds the sync.ef_residual_norm gauge without a blocking readback
+    (converted lazily at snapshot time)."""
+    dvals = delta.at[r_shard, r_cslot].get(mode="fill", fill_value=0)
+    ship = jnp.max(jnp.abs(dvals), axis=1) >= threshold
+    # overflow guard (must match quant.py's host twins bitwise): a
+    # delta beyond the fp16 range would cast to inf, merge an inf into
+    # the owner row FOREVER and park a -inf residual — clip to the
+    # format's max instead; the clipped excess rides the residual and
+    # ships over subsequent rounds (the EF loop absorbs saturation the
+    # same way it absorbs rounding)
+    if mode == "fp16":
+        shipped = jnp.clip(dvals, -F16_MAX, F16_MAX).astype(
+            jnp.float16).astype(dvals.dtype)
+    else:  # int8, symmetric per-row scale rounded through the f16 wire
+        s = jnp.clip(jnp.max(jnp.abs(dvals), axis=1) / 127.0,
+                     0.0, F16_MAX).astype(jnp.float16).astype(dvals.dtype)
+        safe = jnp.where(s > 0, s, 1.0)
+        q = jnp.clip(jnp.round(dvals / safe[:, None]), -127, 127)
+        shipped = q.astype(jnp.int8).astype(dvals.dtype) * s[:, None]
+    resid = dvals - shipped
+    rs = jnp.where(ship, r_cslot, OOB)
+    osl = jnp.where(ship, o_slot, OOB)
+    main = main.at[o_shard, osl].add(shipped, mode="drop")
+    fresh = main.at[o_shard, osl].get(mode="fill", fill_value=0)
+    cache = cache.at[r_shard, rs].set(fresh, mode="drop")
+    new_delta = jnp.where(ship[:, None], resid, dvals)
+    delta = delta.at[r_shard, r_cslot].set(new_delta, mode="drop")
+    resid_norm = jnp.max(jnp.where(ship[:, None], jnp.abs(resid), 0.0))
+    return main, cache, delta, resid_norm
 
 
 @partial(jax.jit, donate_argnums=(0, 1, 2))
@@ -250,7 +304,7 @@ class ShardedStore:
     def __init__(self, num_keys_in_class: int, value_length: int,
                  ctx: MeshContext, dtype=jnp.float32, over_alloc: float = 1.25,
                  cache_slots_per_shard: int = 0, bucket_min: int = 8,
-                 tier_hot_rows: int = 0):
+                 tier_hot_rows: int = 0, tier_cold_dtype: str = "fp32"):
         self.value_length = value_length
         self.ctx = ctx
         self.dtype = dtype
@@ -288,18 +342,27 @@ class ShardedStore:
         # residency map translates slots to hot rows at dispatch time,
         # so routing plans and the addressbook never see the tier.
         self.res = None
-        self.cold = None
+        self.cold = None          # fp32 alias of coldq.q (back-compat)
+        self.coldq = None         # QuantCold (tier/quant.py)
         self.tier_hot_hits = 0   # owner-served gather entries, hot
         self.tier_cold_hits = 0  # owner-served gather entries, cold
         self.tier_hist = None    # cold-serve latency hist (TierManager)
         dev_main_slots = self.main_slots
         if tier_hot_rows > 0:
+            from ..tier.quant import QuantCold
             from ..tier.residency import Residency
             dev_main_slots = _round8(
                 min(self.main_slots, max(8, tier_hot_rows)))
             self.res = Residency(S, self.main_slots, dev_main_slots)
-            self.cold = np.zeros((S, self.main_slots, value_length),
-                                 dtype=np.dtype(dtype))
+            # the cold tier, in --sys.tier.cold_dtype format (fp32 is a
+            # bit-identical raw-array passthrough — the pre-PR pin);
+            # residual capacity scales with the hot pool: the rows that
+            # cycle promote/demote are the ones that park remainders
+            self.coldq = QuantCold(
+                S, self.main_slots, value_length, mode=tier_cold_dtype,
+                resid_cap=min(65536, max(1024, 4 * dev_main_slots)))
+            if tier_cold_dtype == "fp32":
+                self.cold = self.coldq.q
 
         sh = ctx.shard0()
         self.main = jax.device_put(
@@ -334,6 +397,22 @@ class ShardedStore:
         self.main_epoch = np.zeros((S, self.main_slots), dtype=np.int64)
         self.repl_epoch = np.zeros((S, self.cache_slots), dtype=np.int64)
         self.delta_dirty = np.zeros((S, self.cache_slots), dtype=bool)
+
+        # -- sync wire accounting (ISSUE 8; --sys.sync.compress) -----------
+        # bytes one sync round ships in the configured wire format vs
+        # what full-width f32 would have cost for the same rows —
+        # bumped by sync_replicas under the server lock; read by the
+        # sync.bytes_* gauges (core/sync.py). With sync_threshold > 0
+        # the ship/hold decision is on device, so these count the
+        # CONSIDERED rows (an exact on-device count would cost a
+        # readback per round) — same convention as keys_synced.
+        self.sync_bytes_shipped = 0
+        self.sync_bytes_full = 0
+        # max-abs residual parked by the last compressed round: a jnp
+        # scalar kept UNCONVERTED (float() would block the round);
+        # sync.ef_residual_norm converts it lazily at snapshot time
+        self._ef_resid_dev = None
+        self._ef_resid_host = 0.0  # tiered cold-owner (host) rounds
 
         # host-side count of dispatched gather programs. Lock-free (a
         # racing increment may be lost): this is a LIVENESS probe — the
@@ -492,8 +571,15 @@ class ShardedStore:
                 self.main, self.cache, self.delta, *a)
 
     def sync_replicas(self, r_shard, r_cslot, o_shard, o_slot,
-                      threshold: float = 0.0):
+                      threshold: float = 0.0, compress: str = "off"):
         n = len(r_shard)
+        if n:
+            # wire accounting: what this batch ships in `compress`
+            # format vs full-width f32 (tier/quant.py wire table)
+            from ..tier.quant import wire_bytes_per_row
+            self.sync_bytes_shipped += n * wire_bytes_per_row(
+                compress, self.value_length)
+            self.sync_bytes_full += n * 4 * self.value_length
         if threshold <= 0.0:
             r_sh, r_cs = np.asarray(r_shard), np.asarray(r_cslot)
             o_sh, o_sl = np.asarray(o_shard), np.asarray(o_slot)
@@ -517,12 +603,18 @@ class ShardedStore:
             from ..tier import coldpath
             coldpath.sync_replicas_tiered(self, r_shard, r_cslot,
                                           o_shard, o_slot,
-                                          threshold=threshold)
+                                          threshold=threshold,
+                                          compress=compress)
             return
         a = pad_bucket(n, (r_shard, 0), (r_cslot, OOB), (o_shard, 0),
                        (o_slot, OOB), minimum=self.bucket_min)
         with _GATE:
-            if threshold > 0.0:
+            if compress != "off":
+                (self.main, self.cache, self.delta,
+                 self._ef_resid_dev) = _sync_replicas_compressed(
+                    self.main, self.cache, self.delta, *a,
+                    jnp.asarray(threshold, self.dtype), mode=compress)
+            elif threshold > 0.0:
                 self.main, self.cache, self.delta = \
                     _sync_replicas_thresholded(
                         self.main, self.cache, self.delta, *a,
@@ -530,6 +622,16 @@ class ShardedStore:
             else:
                 self.main, self.cache, self.delta = _sync_replicas(
                     self.main, self.cache, self.delta, *a)
+
+    def ef_residual_norm(self) -> float:
+        """Max-abs residual parked by the most recent compressed sync
+        round (device + tiered host paths). Converting the device
+        scalar synchronizes with the round's program — snapshot-time
+        cost only, never on the round itself."""
+        dev = 0.0
+        if self._ef_resid_dev is not None:
+            dev = float(np.asarray(self._ef_resid_dev))
+        return max(dev, self._ef_resid_host)
 
     def relocate_rows(self, old_shard, old_slot, new_shard, new_slot,
                       rc_shard, rc_slot):

@@ -262,6 +262,24 @@ class SyncManager:
             # (docs/OBSERVABILITY.md); both gauges read the same counter
             reg.gauge("sync.keys_shipped",
                       fn=lambda: self.stats.keys_synced)
+            # compression plane (ISSUE 8; schema v7): wire bytes the
+            # most recent round shipped (--sys.sync.compress format),
+            # cumulative shipped vs full-width-f32-equivalent bytes,
+            # and the max-abs EF residual parked by the last
+            # compressed round (0 until a compressed round runs; the
+            # device scalar converts lazily here, at snapshot time)
+            reg.gauge("sync.bytes_per_round",
+                      fn=lambda: self._last_round_bytes)
+            reg.gauge("sync.bytes_shipped",
+                      fn=lambda: sum(st.sync_bytes_shipped
+                                     for st in server.stores))
+            reg.gauge("sync.bytes_full_equiv",
+                      fn=lambda: sum(st.sync_bytes_full
+                                     for st in server.stores))
+            reg.gauge("sync.ef_residual_norm",
+                      fn=lambda: max((st.ef_residual_norm()
+                                      for st in server.stores),
+                                     default=0.0))
             # table occupancy + dirty fraction, per channel and total —
             # host arrays only, no device readback. Best-effort reads
             # (evaluated without the server lock at snapshot time).
@@ -280,6 +298,9 @@ class SyncManager:
                                         dtype=np.int64)
         self._next_channel = 0
         self._last_round_t = 0.0
+        # wire bytes shipped by the most recent sync_channel round
+        # (sync.bytes_per_round gauge; ISSUE 8)
+        self._last_round_bytes = 0
         # per-channel (monotonic, dirty, live) memo for the dirty_fraction
         # gauges — see _dirty_counts
         self._df_cache: dict = {}
@@ -528,8 +549,12 @@ class SyncManager:
                     dirty |= np.isin(kk, kk[dirty])
                 kk, ks = kk[dirty], ks[dirty]
             if len(kk):
+                # periodic rounds ship in the --sys.sync.compress wire
+                # format (the EF residual parks in the delta row);
+                # drop/quiesce flushes stay EXACT — kv.py _sync_replicas
                 srv._sync_replicas(kk, ks,
-                                   threshold=self.opts.sync_threshold)
+                                   threshold=self.opts.sync_threshold,
+                                   compress=True)
                 self.stats.add(keys_synced=len(kk))
         if len(keep_x) and not self.opts.collective_sync:
             # collective mode: cross-process deltas accumulate and ship in
@@ -596,6 +621,15 @@ class SyncManager:
             # round latency measured AFTER the throttle (sleep is policy,
             # not work) — sync.round_s + the "sync.round" span
             from ..obs.metrics import timed
+            # wire bytes this ROUND ships (keep syncs in the
+            # --sys.sync.compress format + drop flushes, which go
+            # exact) — sync.bytes_per_round. Measured here, under the
+            # round lock, across ALL of the round's channels: a
+            # per-channel diff of the shared cumulative counter would
+            # report only the last channel and cross-contaminate when
+            # multi-process rounds issue channels concurrently.
+            bytes_before = sum(st.sync_bytes_shipped
+                               for st in self.server.stores)
             with timed(self._h_round), self.server._span("sync.round"):
                 self.drain_intents(force=force_intents)
                 if all_channels:
@@ -612,6 +646,9 @@ class SyncManager:
                 else:
                     self._maybe_cadence()
                 self.stats.add(rounds=1)
+            self._last_round_bytes = \
+                sum(st.sync_bytes_shipped
+                    for st in self.server.stores) - bytes_before
 
     def _sync_all_channels(self) -> None:
         """All channels' rounds. Multi-process, >1 channel: issued

@@ -1,0 +1,71 @@
+"""Dequant-fused device programs for the quantized cold tier
+(ISSUE 8; tier/quant.py holds the host twins of these transforms).
+
+The co-design point (Tensor Casting, PAPERS.md): the cold store's wire
+format is chosen so the ACCELERATOR inverts it inside the very gather /
+scatter that consumes the rows — the host ships fp16/int8 payloads
+(half / quarter the bytes of f32) and the dequant fuses into the
+program instead of paying a separate host-side pass plus a full-width
+upload:
+
+  - `_gather_cold_fp16` / `_gather_cold_int8`: the cold-miss gather —
+    `store._gather` with the cold override rows arriving in wire
+    format, converted in-program (f16->f32 convert is exact; int8
+    rows multiply by their per-row f32 scale);
+  - `_write_main_rows_fp16` / `_write_main_rows_int8`: the promotion
+    upload — dequantize into the donated hot-pool scatter
+    (tier/promote.py double-buffers these on the `tier`/`tier_commit`
+    streams, so host wire prep of chunk N+1 overlaps chunk N's device
+    scatter).
+
+Exactness contract: these programs and the numpy paths in
+tier/quant.py apply the SAME IEEE f32 operations (convert, multiply),
+so a cold row reads identical bits through the fused device gather,
+the host bulk-read path, and a checkpoint.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def _gather_cold_fp16(main, cache, delta, o_shard, o_row, c_shard,
+                      c_slot, use_cache, cold_q, use_cold):
+    """store._gather with an fp16 wire override for cold owner rows
+    (cold_q: [b, L] f16). The f16->f32 convert is exact — fp16 cold
+    rows read the same bits everywhere."""
+    m = main.at[o_shard, o_row].get(mode="fill", fill_value=0)
+    m = jnp.where(use_cold[:, None], cold_q.astype(main.dtype), m)
+    c = (cache.at[c_shard, c_slot].get(mode="fill", fill_value=0)
+         + delta.at[c_shard, c_slot].get(mode="fill", fill_value=0))
+    return jnp.where(use_cache[:, None], c, m)
+
+
+@jax.jit
+def _gather_cold_int8(main, cache, delta, o_shard, o_row, c_shard,
+                      c_slot, use_cache, cold_q, cold_scale, use_cold):
+    """store._gather with an int8+per-row-scale wire override for cold
+    owner rows (cold_q: [b, L] i8, cold_scale: [b] f32)."""
+    m = main.at[o_shard, o_row].get(mode="fill", fill_value=0)
+    deq = cold_q.astype(main.dtype) * cold_scale[:, None]
+    m = jnp.where(use_cold[:, None], deq, m)
+    c = (cache.at[c_shard, c_slot].get(mode="fill", fill_value=0)
+         + delta.at[c_shard, c_slot].get(mode="fill", fill_value=0))
+    return jnp.where(use_cache[:, None], c, m)
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _write_main_rows_fp16(main, sh, row, qvals):
+    """Promotion upload, fp16 wire: dequantize fused into the donated
+    hot-pool scatter (padding rows carry OOB and drop)."""
+    return main.at[sh, row].set(qvals.astype(main.dtype), mode="drop")
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _write_main_rows_int8(main, sh, row, qvals, scales):
+    """Promotion upload, int8 wire (scales: [b] f32 per-row)."""
+    vals = qvals.astype(main.dtype) * scales[:, None]
+    return main.at[sh, row].set(vals, mode="drop")

@@ -17,6 +17,14 @@ program and preserves its BIT-EXACT semantics (the tentpole contract):
 Callers hold the server lock (the residency discipline, residency.py);
 the readbacks these paths pay ARE the cold tier's cost — misses are
 served correctly and queued for promotion so repeated access turns hot.
+
+Since ISSUE 8 the cold store may be QUANTIZED (--sys.tier.cold_dtype;
+tier/quant.py): every access below goes through the `store.coldq`
+surface, whose fp32 mode is a bit-identical raw-array passthrough (the
+pre-PR pin) and whose fp16/int8 modes follow the error-compensated
+contract in docs/MEMORY.md — the visible value of a cold row is its
+dequantized stored value, identical through the dequant-fused device
+gather (ops/dequant.py) and the host read paths here.
 """
 from __future__ import annotations
 
@@ -69,6 +77,16 @@ def _install_cache_rows(cache, delta, c_shard, c_slot, vals):
     without the cross-process tracking semantics)."""
     cache = cache.at[c_shard, c_slot].set(vals, mode="drop")
     delta = delta.at[c_shard, c_slot].set(jnp.zeros_like(vals), mode="drop")
+    return cache, delta
+
+
+@partial(jax.jit, donate_argnums=(0, 1))
+def _install_cache_rows_resid(cache, delta, c_shard, c_slot, vals, resid):
+    """Compressed cold-owner sync refresh: install the fresh base and
+    PARK the quantization residual in the delta row instead of zeroing
+    it (the EF loop's host twin of _sync_replicas_compressed)."""
+    cache = cache.at[c_shard, c_slot].set(vals, mode="drop")
+    delta = delta.at[c_shard, c_slot].set(resid, mode="drop")
     return cache, delta
 
 
@@ -131,14 +149,36 @@ def gather_tiered(store, o_shard, o_slot, c_shard, c_slot, use_cache):
                                      store.delta, *a)
     t0 = time.perf_counter()
     b = a[0].shape[0]
-    cold_vals = np.zeros((b, store.value_length),
-                         dtype=np.dtype(store.dtype))
-    cold_vals[:n][cold] = store.cold[o_sh[cold], o_sl[cold]]
     use_cold = np.zeros(b, dtype=bool)
     use_cold[:n] = cold
-    with _GATE:
-        out = _gather_cold(store.main, store.cache, store.delta, *a,
-                           cold_vals, use_cold)
+    mode = store.coldq.mode
+    if mode == "fp32":
+        cold_vals = np.zeros((b, store.value_length),
+                             dtype=np.dtype(store.dtype))
+        cold_vals[:n][cold] = store.coldq.read(o_sh[cold], o_sl[cold])
+        with _GATE:
+            out = _gather_cold(store.main, store.cache, store.delta, *a,
+                               cold_vals, use_cold)
+    else:
+        # dequant-fused cold-miss gather (ops/dequant.py): ship the
+        # WIRE rows — half/quarter the host->device bytes — and invert
+        # the format inside the gather program itself
+        from ..ops import dequant
+        q, s = store.coldq.wire(o_sh[cold], o_sl[cold])
+        qbuf = np.zeros((b, store.value_length), dtype=q.dtype)
+        qbuf[:n][cold] = q
+        if mode == "fp16":
+            with _GATE:
+                out = dequant._gather_cold_fp16(
+                    store.main, store.cache, store.delta, *a,
+                    qbuf, use_cold)
+        else:
+            sbuf = np.zeros(b, dtype=np.float32)
+            sbuf[:n][cold] = s
+            with _GATE:
+                out = dequant._gather_cold_int8(
+                    store.main, store.cache, store.delta, *a,
+                    qbuf, sbuf, use_cold)
     if store.tier_hist is not None:
         store.tier_hist.observe(time.perf_counter() - t0)
     return out
@@ -153,8 +193,9 @@ def scatter_add_tiered(store, o_shard, o_slot, d_shard, d_slot, vals):
         len(o_sh), store.value_length)
     if cold.any():
         # additive merge on the authoritative host row (in-batch
-        # duplicates accumulate in batch order, like the device scatter)
-        np.add.at(store.cold, (o_sh[cold], o_sl[cold]), rows[cold])
+        # duplicates accumulate in batch order, like the device
+        # scatter; quantized modes fold through the EF residual)
+        store.coldq.add_at(o_sh[cold], o_sl[cold], rows[cold])
     n = len(o_sh)
     a = pad_bucket(n, (o_sh.astype(np.int32), 0), (g_row, OOB),
                    (d_shard, 0), (d_slot, OOB), minimum=store.bucket_min)
@@ -172,7 +213,7 @@ def set_rows_tiered(store, o_shard, o_slot, vals, c_shard, c_slot):
     rows = np.asarray(vals, dtype=np.dtype(store.dtype)).reshape(
         len(o_sh), store.value_length)
     if cold.any():
-        store.cold[o_sh[cold], o_sl[cold]] = rows[cold]
+        store.coldq.set_at(o_sh[cold], o_sl[cold], rows[cold])
     n = len(o_sh)
     a = pad_bucket(n, (o_sh.astype(np.int32), 0), (g_row, OOB),
                    (c_shard, 0), (c_slot, OOB), minimum=store.bucket_min)
@@ -201,7 +242,9 @@ def replica_create_tiered(store, o_shard, o_slot, c_shard, c_slot):
             store.cache, store.delta = store_mod._replica_create(
                 store.main, store.cache, store.delta, *a)
     if cold.any():
-        vals = store.cold[o_sh[cold], o_sl[cold]]
+        # a fresh replica copies the VISIBLE cold value (deq only —
+        # the parked residual stays with the owner row)
+        vals = store.coldq.read(o_sh[cold], o_sl[cold])
         a = pad_bucket(int(cold.sum()), (c_sh[cold], 0), (c_sl[cold], OOB),
                        minimum=store.bucket_min)
         v = store._vals_bucket(vals, a[0].shape[0])
@@ -211,11 +254,15 @@ def replica_create_tiered(store, o_shard, o_slot, c_shard, c_slot):
 
 
 def sync_replicas_tiered(store, r_shard, r_cslot, o_shard, o_slot,
-                         threshold: float = 0.0):
+                         threshold: float = 0.0, compress: str = "off"):
     """One sync batch with tier-aware owners: replicas of hot owners
     ride the fused device program; replicas of cold owners sync through
     the cold path — delta readback → host merge → base install (the
-    tentpole's "replicas of cold keys sync through the cold path")."""
+    tentpole's "replicas of cold keys sync through the cold path").
+    `compress` applies the --sys.sync.compress wire transform on both
+    halves: the device program for hot owners, the host twin
+    (quant.compress_delta) for cold owners — with the residual parked
+    in the replica's delta row either way."""
     r_sh = np.asarray(r_shard, dtype=np.int32).ravel()
     r_cs = np.asarray(r_cslot, dtype=np.int32).ravel()
     o_sh = np.asarray(o_shard, dtype=np.int64).ravel()
@@ -227,7 +274,14 @@ def sync_replicas_tiered(store, r_shard, r_cslot, o_shard, o_slot,
                        (o_sh[hot].astype(np.int32), 0), (g_row[hot], OOB),
                        minimum=store.bucket_min)
         with _GATE:
-            if threshold > 0.0:
+            if compress != "off":
+                (store.main, store.cache, store.delta,
+                 store._ef_resid_dev) = \
+                    store_mod._sync_replicas_compressed(
+                        store.main, store.cache, store.delta, *a,
+                        jnp.asarray(threshold, store.dtype),
+                        mode=compress)
+            elif threshold > 0.0:
                 store.main, store.cache, store.delta = \
                     store_mod._sync_replicas_thresholded(
                         store.main, store.cache, store.delta, *a,
@@ -250,17 +304,33 @@ def sync_replicas_tiered(store, r_shard, r_cslot, o_shard, o_slot,
         ship = np.max(np.abs(dvals), axis=1) >= threshold
     if ship.any():
         si = ci[ship]
+        merged = dvals[ship]
+        resid = None
+        if compress != "off":
+            # host twin of _sync_replicas_compressed: the owner merges
+            # what the wire format reconstructs; the remainder parks in
+            # the replica's delta row below
+            from .quant import compress_delta
+            merged, resid = compress_delta(compress, merged)
+            if len(resid):
+                store._ef_resid_host = float(np.max(np.abs(resid)))
         # merge-all THEN refresh-all, like the device program: all
         # shipped deltas land before any fresh value is read, so every
         # replica of a key sees the post-merge value
-        np.add.at(store.cold, (o_sh[si], o_sl[si]), dvals[ship])
-        fresh = store.cold[o_sh[si], o_sl[si]]
+        store.coldq.add_at(o_sh[si], o_sl[si], merged)
+        fresh = store.coldq.read(o_sh[si], o_sl[si])
         a = pad_bucket(len(si), (r_sh[si], 0), (r_cs[si], OOB),
                        minimum=store.bucket_min)
         v = store._vals_bucket(fresh, a[0].shape[0])
-        with _GATE:
-            store.cache, store.delta = _install_cache_rows(
-                store.cache, store.delta, *a, v)
+        if resid is None:
+            with _GATE:
+                store.cache, store.delta = _install_cache_rows(
+                    store.cache, store.delta, *a, v)
+        else:
+            rv = store._vals_bucket(resid, a[0].shape[0])
+            with _GATE:
+                store.cache, store.delta = _install_cache_rows_resid(
+                    store.cache, store.delta, *a, v, rv)
     if store.tier_hist is not None:
         store.tier_hist.observe(time.perf_counter() - t0)
 
@@ -289,7 +359,10 @@ def relocate_tiered(store, old_shard, old_slot, new_shard, new_slot,
         rows[hot] = store.read_hot_rows_at(old_sh[hot].astype(np.int32),
                                            g_row[hot])
     if cold.any():
-        rows[cold] = store.cold[old_sh[cold], old_sl[cold]]
+        # a relocation MOVES the authoritative value: take the full-
+        # precision row (deq + parked residual, consuming it) so the
+        # error-feedback state travels with the key
+        rows[cold] = store.coldq.take_true(old_sh[cold], old_sl[cold])
     has_rc = (rc_sl != OOB) & (rc_sl >= 0)
     if has_rc.any():
         d = store.read_rows("delta", rc_sh[has_rc], rc_sl[has_rc])
@@ -302,7 +375,7 @@ def relocate_tiered(store, old_shard, old_slot, new_shard, new_slot,
     release_rows(store, old_sh[valid], old_sl[valid])
     dst_ok = (new_sl >= 0) & (new_sl != OOB)
     if dst_ok.any():
-        store.cold[new_sh[dst_ok], new_sl[dst_ok]] = rows[dst_ok]
+        store.coldq.set_at(new_sh[dst_ok], new_sl[dst_ok], rows[dst_ok])
         # defensively clear any stale mapping at the destination slot
         # (a correctly-released slot is already -1)
         store.res.dev_row[new_sh[dst_ok], new_sl[dst_ok]] = -1
@@ -322,7 +395,7 @@ def read_main_rows_tiered(store, sh, sl) -> np.ndarray:
         out[hot] = store.read_hot_rows_at(sh[hot].astype(np.int32),
                                           g_row[hot])
     if cold.any():
-        out[cold] = store.cold[sh[cold], sl[cold]]
+        out[cold] = store.coldq.read(sh[cold], sl[cold])
     return out
 
 
@@ -335,7 +408,10 @@ def read_main_rows_bulk(store, sh: np.ndarray,
     hot-pool-sized readback (bounded by hot_rows, not model size)."""
     sh = np.asarray(sh, dtype=np.int64).ravel()
     sl = np.asarray(sl, dtype=np.int64).ravel()
-    out = store.cold[sh, sl]          # fancy index -> copy of the rows
+    # fancy index -> copy of the REQUESTED rows only; quantized modes
+    # dequantize that same bounded slice (wire copy + f32 result), so
+    # the dequant path keeps the no-second-full-table-copy contract
+    out = store.coldq.read(sh, sl)
     rows = store.res.dev_row[sh, sl]
     m = rows >= 0
     if m.any():
@@ -348,7 +424,7 @@ def main_full_host(store) -> np.ndarray:
     """Assemble the full authoritative main table [S, main_slots, L] on
     host (checkpoint save, bulk reads): the cold store overlaid with the
     hot pool's rows. One device readback of the whole hot pool."""
-    full = store.cold.copy()
+    full = store.coldq.full()
     res = store.res
     sh_idx, row_idx = np.nonzero(res.row_slot >= 0)
     if len(sh_idx):
@@ -363,8 +439,5 @@ def install_main_full(store, arr: np.ndarray) -> None:
     becomes the cold store and residency resets — everything cold,
     re-promoted lazily by access/intent (the restore contract,
     tests/test_tier.py)."""
-    assert arr.shape == store.cold.shape, (
-        f"main table geometry mismatch: checkpoint {arr.shape} vs "
-        f"tiered store {store.cold.shape}")
-    store.cold[:] = np.asarray(arr, dtype=np.dtype(store.dtype))
+    store.coldq.install_full(np.asarray(arr, dtype=np.dtype(store.dtype)))
     store.res.reset()

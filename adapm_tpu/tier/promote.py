@@ -51,14 +51,45 @@ def promote_rows(store, shard: int, slots: np.ndarray) -> int:
     take = slots[: len(rows)]
     if len(take) == 0:
         return 0
-    vals = store.cold[shard, take]
     a = pad_bucket(len(take),
                    (np.full(len(take), shard, np.int32), 0),
                    (rows.astype(np.int32), OOB),
                    minimum=store.bucket_min)
-    v = store._vals_bucket(vals, a[0].shape[0])
-    with _GATE:
-        store.main = _write_main_rows(store.main, a[0], a[1], v)
+    b = a[0].shape[0]
+    mode = store.coldq.mode
+    if mode == "fp32":
+        v = store._vals_bucket(store.coldq.read(
+            np.full(len(take), shard), take), b)
+        with _GATE:
+            store.main = _write_main_rows(store.main, a[0], a[1], v)
+    else:
+        # dequant-fused upload (ops/dequant.py): ship the WIRE rows —
+        # half/quarter the host->device bytes — and invert the format
+        # inside the donated scatter. Rows with a parked EF residual
+        # (few) get their full-precision value re-set exactly right
+        # after: the residual folds into the promote, so the hot row
+        # carries the true long-run sum (docs/MEMORY.md contract).
+        from ..ops import dequant
+        q, s, fix_pos, fix_vals = store.coldq.promote_wire(shard, take)
+        qb = np.zeros((b, store.value_length), dtype=q.dtype)
+        qb[: len(take)] = q
+        with _GATE:
+            if mode == "fp16":
+                store.main = dequant._write_main_rows_fp16(
+                    store.main, a[0], a[1], qb)
+            else:
+                sb = np.zeros(b, dtype=np.float32)
+                sb[: len(take)] = s
+                store.main = dequant._write_main_rows_int8(
+                    store.main, a[0], a[1], qb, sb)
+        if len(fix_pos):
+            f = pad_bucket(len(fix_pos),
+                           (np.full(len(fix_pos), shard, np.int32), 0),
+                           (rows[fix_pos].astype(np.int32), OOB),
+                           minimum=store.bucket_min)
+            fv = store._vals_bucket(fix_vals, f[0].shape[0])
+            with _GATE:
+                store.main = _write_main_rows(store.main, f[0], f[1], fv)
     res.dev_row[shard, take] = rows
     res.row_slot[shard, rows] = take
     res.epoch += 1
@@ -79,7 +110,10 @@ def demote_rows(store, shard: int, slots: np.ndarray) -> int:
         return 0
     vals = store.read_hot_rows_at(
         np.full(len(rows), shard, dtype=np.int32), rows.astype(np.int32))
-    store.cold[shard, slots] = vals
+    # land the readback in the cold tier's at-rest format; quantized
+    # modes park the sub-grid remainder as the demote's EF residual
+    # (folded back in at the next promote — docs/MEMORY.md contract)
+    store.coldq.set_at(np.full(len(slots), shard), slots, vals)
     res.dev_row[shard, slots] = -1
     res.row_slot[shard, rows] = -1
     res.alloc.free_batch(shard, rows)
@@ -109,6 +143,9 @@ def release_rows(store, shards: np.ndarray, slots: np.ndarray) -> None:
             changed = True
         res.score[s, sl] = 0
         res.pin_until[s, sl] = -1
+        # the slot's value has left the store: its parked EF residual
+        # must not leak onto whatever key reuses the slot
+        store.coldq.drop_resid(np.full(len(sl), int(s)), sl)
     if changed:
         res.epoch += 1
 

@@ -158,6 +158,19 @@ class TierManager:
             reg.gauge("tier.hot_rows_capacity",
                       fn=lambda: sum(st.res.hot_rows * st.res.num_shards
                                      for st in server.stores))
+            # compression plane (ISSUE 8; schema v7): actual host bytes
+            # per cold row — dense store + scale column + parked EF
+            # residuals, averaged over classes weighted by rows — plus
+            # the residual-map health pair (rows parked / evicted at
+            # the cap; evictions inject bounded error, never silent)
+            reg.gauge("tier.cold_bytes_per_row",
+                      fn=lambda: self.cold_bytes_per_row())
+            reg.gauge("tier.ef_resid_rows",
+                      fn=lambda: sum(st.coldq.resid_rows()
+                                     for st in server.stores))
+            reg.gauge("tier.ef_evicted",
+                      fn=lambda: sum(st.coldq.ef_evicted
+                                     for st in server.stores))
         # the cold-serve latency histogram is observed from inside the
         # store's gather path — hand the stores the handle; the wake
         # hook lets the miss path kick the maintenance worker
@@ -176,6 +189,16 @@ class TierManager:
         batch. In-flight residency-dependent plans revalidate against
         it, exactly like topology_version."""
         return sum(st.res.epoch for st in self.server.stores)
+
+    def cold_bytes_per_row(self) -> float:
+        """Host bytes one cold-tier row actually costs (fp32 = 4L; the
+        quantized modes' savings INCLUDING scale columns and parked
+        residuals — the honest number the bench compress phase and
+        docs/MEMORY.md quote)."""
+        total_bytes = sum(st.coldq.nbytes() for st in self.server.stores)
+        total_rows = sum(st.coldq.num_shards * st.coldq.main_slots
+                         for st in self.server.stores)
+        return total_bytes / total_rows if total_rows else 0.0
 
     def hot_hit_rate(self) -> float:
         """Fraction of owner-served gather entries served from the

@@ -11,7 +11,9 @@ Each rank times, against keys homed on the next rank:
   - remote push  (keys/s, MiB/s)  — GlobalPM.request_write round trips
   - sync rounds  (keys/s)         — replicate a working set via intent,
     then time planner rounds that extract deltas, ship them, and install
-    fresh bases (pm.sync_replicas)
+    fresh bases (pm.sync_replicas); reports the round's LIVE replica
+    rows and raw-f32 vs --sys.sync.compress (fp16/int8) wire bytes per
+    round (ISSUE 8 — the compressed program's future-DCN bytes)
 
 Rank 0 prints one JSON line. Results recorded in docs/PERF.md ("DCN
 data plane"). CPU platform: this path is host+DCN-bound by design — the
@@ -101,6 +103,18 @@ def child() -> None:
     assert (srv.ab.cache_slot[w.shard, batch] >= 0).mean() > 0.9, \
         "expected the working set to be replicated"
     t_sync = timed(lambda: pm.sync_replicas(batch, all_shards))
+    # wire bytes one sync round ships, counted from the round's LIVE
+    # replica population (the r8 dirty filter and drop races can shrink
+    # a round below BATCH — assuming full-width batch-sized deltas
+    # overstates the plane). Raw = today's full-width f32 delta
+    # direction; fp16/int8 = what the --sys.sync.compress wire formats
+    # cost for the SAME rows (ISSUE 8; tier/quant.py wire table — the
+    # future-DCN bytes/round the compressed sync program produces). The
+    # fresh-base return direction stays full-width in every mode.
+    from adapm_tpu.tier.quant import wire_bytes_per_row
+    sync_rows = int((srv.ab.cache_slot[w.shard, batch] >= 0).sum())
+    sync_wire = {m: sync_rows * wire_bytes_per_row(m, L)
+                 for m in ("off", "fp16", "int8")}
 
     # channel overlap (VERDICT r4 item 9): the working set spans all sync
     # channels (Knuth-hash partition); per-channel rounds hold only their
@@ -153,6 +167,16 @@ def child() -> None:
         "pull_keys_per_s_inflight": inflight,
         "sync_round_ms": round(t_sync * 1e3, 2),
         "sync_keys_per_s": round(BATCH / t_sync),
+        "sync_rows_per_round": sync_rows,
+        "sync_delta_bytes_per_round": {
+            "raw_fp32": sync_wire["off"],
+            "fp16": sync_wire["fp16"],
+            "int8": sync_wire["int8"]},
+        "sync_compress_ratio": {
+            "fp16": round(sync_wire["fp16"] / sync_wire["off"], 4),
+            "int8": round(sync_wire["int8"] / sync_wire["off"], 4)},
+        "sync_delta_MiB_per_s_raw": round(
+            sync_wire["off"] / 2**20 / t_sync, 1),
         "chan_rounds": len(per_chan),
         "chan_serial_ms": round(t_chan_serial * 1e3, 2),
         "chan_overlap_ms": round(t_chan_overlap * 1e3, 2),

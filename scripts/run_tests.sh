@@ -34,6 +34,13 @@ python scripts/tier_residency_check.py
 # must keep up with the serialized single-stream fallback on a tiered
 # promotion-churn workload (median pairwise ratio; overlap_fraction > 0)
 python scripts/exec_overlap_check.py
+# compression-plane guard (ISSUE 8): a randomized push/promote/demote/
+# sync storm with both features OFF must stay bit-identical to an
+# untiered fp32 shadow (the pre-PR pin), the fp16/int8 storms must keep
+# every read under the docs/MEMORY.md contract bound (the EF residual
+# loop bounding drift), and compressed sync rounds must ship <= 0.55x
+# (fp16) / 0.30x (int8) of the shadow's full-width bytes
+python scripts/compress_drift_check.py
 # SLO-autopilot guard (ISSUE 7): with --sys.serve.slo_ms set against an
 # oversized micro-batch window, the closed-loop controller must walk
 # max_wait_us DOWN and land the observed serve P99 within the tolerance

@@ -230,6 +230,57 @@ def test_tier_knobs_round_trip_and_rejection():
     SystemOptions.from_args(p.parse_args(["--sys.tier.hot_rows", "4"]))
 
 
+def test_compression_knobs_round_trip_and_rejection():
+    """--sys.tier.cold_dtype / --sys.sync.compress parse into the
+    options the compression plane consumes, and invalid names or
+    inconsistent combinations fail loudly at parse time (ISSUE 8)."""
+    import argparse
+
+    import pytest
+
+    from adapm_tpu.config import SystemOptions
+    p = argparse.ArgumentParser()
+    SystemOptions.add_arguments(p)
+    dflt = SystemOptions.from_args(p.parse_args([]))
+    # both DEFAULT to the pre-PR exact wire: fp32 at rest, no sync
+    # compression (the bit-identity pin run_tests.sh guards)
+    assert dflt.tier_cold_dtype == "fp32"
+    assert dflt.sync_compress == "off"
+    on = SystemOptions.from_args(p.parse_args(
+        ["--sys.tier", "1", "--sys.tier.cold_dtype", "fp16",
+         "--sys.sync.compress", "fp16"]))
+    assert on.tier_cold_dtype == "fp16" and on.sync_compress == "fp16"
+    i8 = SystemOptions.from_args(p.parse_args(
+        ["--sys.tier", "1", "--sys.tier.cold_dtype", "int8",
+         "--sys.sync.compress", "int8"]))
+    assert i8.tier_cold_dtype == "int8" and i8.sync_compress == "int8"
+    # invalid dtype names: argparse choices reject unknown wire formats
+    # before the options object even exists
+    with pytest.raises(SystemExit):
+        p.parse_args(["--sys.tier.cold_dtype", "fp8"])
+    with pytest.raises(SystemExit):
+        p.parse_args(["--sys.sync.compress", "bf16"])
+    # hand-built options (no argparse choices) reject through validate
+    with pytest.raises(ValueError):
+        SystemOptions(tier_cold_dtype="fp8").validate_serve()
+    with pytest.raises(ValueError):
+        SystemOptions(sync_compress="bf16").validate_serve()
+    # int8 sync without metrics: the EF residual loop would be invisible
+    # (no sync.ef_residual_norm gauge) — a silent-quality-loss trap
+    with pytest.raises(ValueError):
+        SystemOptions.from_args(p.parse_args(
+            ["--sys.sync.compress", "int8", "--sys.metrics", "0"]))
+    # fp16 sync is allowed without metrics (residual bounded by the
+    # representation, not the feedback loop alone)
+    SystemOptions.from_args(p.parse_args(
+        ["--sys.sync.compress", "fp16", "--sys.metrics", "0"]))
+    # compression requires the dirty filter: the full-resync path has
+    # no epoch state for residual-parked-but-clean replicas
+    with pytest.raises(ValueError):
+        SystemOptions.from_args(p.parse_args(
+            ["--sys.sync.compress", "fp16", "--sys.sync.dirty_only", "0"]))
+
+
 def test_collective_sync_knobs():
     """--sys.collective_sync / --sys.collective_bucket parse into the
     options GlobalPM consults when choosing the sync data plane."""

@@ -1,0 +1,447 @@
+"""Compression co-design (ISSUE 8): quantized cold tier
+(--sys.tier.cold_dtype; tier/quant.py) + error-fed delta-compressed
+sync (--sys.sync.compress; store._sync_replicas_compressed).
+
+The load-bearing tests are the two storms:
+
+  - the QUANTIZED tier storm — a randomized push / set / relocate /
+    replica-churn / sync / promote / demote interleaving on a tiered
+    fp16/int8 server vs an untiered fp32 shadow, with every read (and
+    the post-quiesce final read) bounded by the documented numeric
+    contract (docs/MEMORY.md "Cold-row numeric contract"): visible
+    error never exceeds a couple of grid steps, regardless of how many
+    promote/demote/write cycles a row went through (the EF residual is
+    what makes that a bound instead of a random walk);
+  - the EXACT-case pin — values on the fp16 grid survive promote /
+    demote / relocation cycles BIT-identically (the "exact on the
+    fp16-representable cases" half of the contract).
+
+Plus: wire-format units (host and the jitted device twins must agree),
+EF sum preservation, sub-grid update accumulation (the classic EF-SGD
+property: a stream of updates each too small to quantize still lands),
+sync byte accounting (half / quarter), exact flush at drop/quiesce,
+and the beyond-HBM host-RAM contract for `_read_owned_bulk` + the
+dequant read path (no transient second full-table copy).
+"""
+import tracemalloc
+
+import numpy as np
+import pytest
+
+import adapm_tpu
+from adapm_tpu.base import CLOCK_MAX, MgmtTechniques
+from adapm_tpu.config import SystemOptions
+from adapm_tpu.tier.quant import (QuantCold, compress_delta,
+                                  dequantize_rows, grid_step,
+                                  quantize_rows, wire_bytes_per_row)
+
+E = 384
+L = 8
+
+
+def _mk(tier: bool, hot_rows: int = 16, **kw):
+    opts = SystemOptions(sync_max_per_sec=0, prefetch=False,
+                         tier=tier, tier_hot_rows=hot_rows, **kw)
+    return adapm_tpu.setup(E, L, opts=opts)
+
+
+def _read_all(srv):
+    return np.asarray(srv.read_main(np.arange(E)))
+
+
+def _grid_tol(mode: str, rows: np.ndarray) -> np.ndarray:
+    """Per-row bound from the documented contract (docs/MEMORY.md):
+    two grid steps of the row's max-abs — one for the at-rest rounding,
+    one for a parked residual's worth of slack."""
+    return 2.0 * grid_step(mode, rows) + 1e-6
+
+
+# ---------------------------------------------------------------------------
+# wire-format units
+# ---------------------------------------------------------------------------
+
+
+def test_wire_bytes_per_row_table():
+    assert wire_bytes_per_row("off", 16) == 64
+    assert wire_bytes_per_row("fp32", 16) == 64
+    assert wire_bytes_per_row("fp16", 16) == 32   # half
+    assert wire_bytes_per_row("int8", 16) == 18   # quarter + f16 scale
+    with pytest.raises(ValueError):
+        wire_bytes_per_row("fp8", 16)
+
+
+def test_quantize_exact_on_grid(rng):
+    # fp16: values already representable round-trip exactly
+    v = rng.normal(size=(32, L)).astype(np.float16).astype(np.float32)
+    q, s = quantize_rows("fp16", v)
+    assert np.array_equal(dequantize_rows("fp16", q, s), v)
+    # int8: rows of integers with max 127 -> scale 1.0 (f16-exact),
+    # every element on the grid
+    vi = rng.integers(-127, 128, size=(32, L)).astype(np.float32)
+    vi[:, 0] = 127.0  # pin the scale
+    q, s = quantize_rows("int8", vi)
+    assert np.array_equal(s, np.ones(32, np.float32))
+    assert np.array_equal(dequantize_rows("int8", q, s), vi)
+
+
+@pytest.mark.parametrize("mode", ["fp16", "int8"])
+def test_compress_delta_ef_preserves_sum(rng, mode):
+    d = (rng.normal(size=(64, L)) * 10.0 ** rng.integers(
+        -3, 3, size=(64, 1))).astype(np.float32)
+    d[0] = 0.0  # all-zero row: ships zero, residual zero
+    shipped, resid = compress_delta(mode, d)
+    # EF identity: what the owner merges plus what stays parked is the
+    # original delta (up to one f32 rounding of the subtraction)
+    err = np.abs((shipped + resid) - d)
+    assert err.max() <= 4 * np.spacing(np.abs(d).max(), dtype=np.float32)
+    # the parked residual is sub-grid: bounded by one step
+    step = (np.max(np.abs(d), axis=1) * 2.0 ** -11 if mode == "fp16"
+            else np.max(np.abs(d), axis=1) / 127.0)
+    assert (np.max(np.abs(resid), axis=1) <= step + 1e-7).all()
+    assert not shipped[1:].any() or np.abs(shipped).max() > 0
+
+
+def test_device_and_host_wire_transforms_agree(rng):
+    """The jitted compressed-sync program and quant.compress_delta must
+    produce the SAME shipped values (the tiered cold-owner path runs
+    the host twin against device rounds) — including the overflow clamp
+    (the 1e9 row: beyond-f16-range values saturate at F16_MAX instead
+    of casting to inf and poisoning the EF loop with inf - inf = NaN;
+    the int8 row's f16-rounded scale clips the same way)."""
+    import jax.numpy as jnp
+
+    from adapm_tpu.core.store import OOB, _sync_replicas_compressed
+    n, vlen = 8, L
+    d = (rng.normal(size=(n, vlen)) * [[0.01], [0.1], [1], [10], [100],
+                                       [1000], [0.001], [1e9]]
+         ).astype(np.float32)
+    for mode in ("fp16", "int8"):
+        shipped_host, resid_host = compress_delta(mode, d)
+        assert np.isfinite(shipped_host).all(), mode
+        assert np.isfinite(resid_host).all(), mode
+        # EF identity holds for the saturated row too: the clipped
+        # excess is carried in the residual, nothing became inf/NaN
+        err = np.abs((shipped_host + resid_host) - d)
+        assert err.max() <= 4 * np.spacing(np.abs(d).max(),
+                                           dtype=np.float32), mode
+        main = jnp.zeros((1, n, vlen), jnp.float32)
+        cache = jnp.zeros((1, n, vlen), jnp.float32)
+        # the program DONATES delta, and jnp.asarray of a numpy array
+        # can be zero-copy on CPU — hand it its OWN buffer or the
+        # donation clobbers `d` in place (timing-dependent)
+        delta = jnp.asarray(d.reshape(1, n, vlen).copy())
+        z = np.zeros(n, np.int32)
+        idx = np.arange(n, dtype=np.int32)
+        main2, cache2, delta2, norm = _sync_replicas_compressed(
+            main, cache, delta, z, idx, z, idx,
+            jnp.float32(0.0), mode=mode)
+        assert np.array_equal(np.asarray(main2)[0], shipped_host), mode
+        assert np.array_equal(np.asarray(delta2)[0], resid_host), mode
+        assert float(norm) == np.abs(resid_host).max()
+
+
+# ---------------------------------------------------------------------------
+# QuantCold mechanics
+# ---------------------------------------------------------------------------
+
+
+def test_quantcold_ef_accumulates_subgrid_adds(rng):
+    """The EF-SGD property at rest: a stream of updates each below the
+    int8 grid must still land — without the residual every one of them
+    would round to zero and the row would never move."""
+    qc = QuantCold(1, 4, L, mode="int8")
+    base = np.full((1, L), 100.0, np.float32)  # grid step ~ 0.787
+    qc.set_at(np.array([0]), np.array([1]), base)
+    tiny = np.full((1, L), 0.1, np.float32)    # ~ step / 8
+    for _ in range(40):
+        qc.add_at(np.array([0]), np.array([1]), tiny)
+    true = 100.0 + 40 * 0.1
+    vis = qc.read(np.array([0]), np.array([1]))[0]
+    step = true / 127.0
+    assert np.abs(vis - true).max() <= step + 1e-5
+    # take_true folds the parked remainder back: sub-step accurate
+    full = qc.take_true(np.array([0]), np.array([1]))[0]
+    assert np.abs(full - true).max() <= 1e-3
+
+
+def test_quantcold_duplicate_adds_batch_order(rng):
+    """In-batch duplicate coordinates accumulate like np.add.at on
+    every mode (the device-scatter contract)."""
+    sh = np.array([0, 0, 0, 0])
+    sl = np.array([2, 3, 2, 2])
+    rows = rng.normal(size=(4, L)).astype(np.float32) * 100
+    for mode in ("fp32", "fp16", "int8"):
+        qc = QuantCold(1, 4, L, mode=mode)
+        qc.add_at(sh, sl, rows)
+        want2 = rows[0] + rows[2] + rows[3]
+        got2 = qc.take_true(np.array([0]), np.array([2]))[0]
+        tol = _grid_tol("int8" if mode == "int8" else "fp16",
+                        want2[None])[0] if mode != "fp32" else 0.0
+        assert np.abs(got2 - want2).max() <= tol + 1e-4
+        if mode == "fp32":
+            assert np.array_equal(got2, want2)
+
+
+def test_quantcold_resid_cap_evicts_counted(rng):
+    qc = QuantCold(1, 64, L, mode="int8", resid_cap=8)
+    vals = rng.normal(size=(32, L)).astype(np.float32) * 3.14159
+    qc.set_at(np.zeros(32, np.int64), np.arange(32), vals)
+    assert qc.resid_rows() <= 8
+    assert qc.ef_evicted > 0  # overflow is counted, never silent
+    # accounting covers the parked rows
+    assert qc.nbytes() >= qc.q.nbytes + qc.scale.nbytes
+
+
+# ---------------------------------------------------------------------------
+# THE quantized-tier drift storm (vs fp32 shadow)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("mode", ["fp16", "int8"])
+def test_quant_storm_drift_bounded(rng, mode):
+    srv = _mk(True, hot_rows=16, tier_cold_dtype=mode,
+              sync_compress=mode)
+    ref = _mk(False)
+    w, wr = srv.make_worker(0), ref.make_worker(0)
+    vals = rng.normal(size=(E, L)).astype(np.float32)
+    for ww in (w, wr):
+        ww.set(np.arange(E), vals)
+    keys = np.arange(E)
+    for step in range(40):
+        op = rng.integers(0, 7)
+        if op == 0:
+            ks = rng.integers(0, E, 24)
+            v = rng.normal(size=(24, L)).astype(np.float32)
+            w.push(ks, v)
+            wr.push(ks, v)
+        elif op == 1:
+            ks = rng.choice(E, 16, replace=False)
+            v = rng.normal(size=(16, L)).astype(np.float32)
+            w.set(ks, v)
+            wr.set(ks, v)
+        elif op == 2:
+            ks = rng.choice(E, 12, replace=False)
+            dest = int(rng.integers(0, srv.num_shards))
+            srv._relocate_to(ks, dest)
+            ref._relocate_to(ks, dest)
+        elif op == 3:
+            ks = rng.choice(keys[srv.ab.owner[keys] != w.shard], 16,
+                            replace=False)
+            end = int(w.current_clock + rng.integers(1, 4))
+            w.intent(ks, w.current_clock, end)
+            wr.intent(ks, wr.current_clock, end)
+            srv.sync.run_round(force_intents=True, all_channels=True)
+            ref.sync.run_round(force_intents=True, all_channels=True)
+        elif op == 4:
+            srv.sync.run_round(force_intents=True, all_channels=True)
+            ref.sync.run_round(force_intents=True, all_channels=True)
+        elif op == 5:
+            srv.tier.promote_keys(rng.choice(E, 32, replace=False))
+        else:
+            srv.tier.demote_keys(rng.choice(E, 32, replace=False))
+            srv.tier.maintain()
+        if rng.integers(0, 3) == 0:
+            w.advance_clock()
+            wr.advance_clock()
+        a = _read_all(srv).reshape(E, L)
+        b = _read_all(ref).reshape(E, L)
+        tol = _grid_tol(mode, b)
+        assert (np.abs(a - b).max(axis=1) <= tol).all(), (
+            f"step {step} (op {op}): drift "
+            f"{np.abs(a - b).max():.3g} exceeds the {mode} contract")
+    srv.quiesce()
+    ref.quiesce()
+    a = _read_all(srv).reshape(E, L)
+    b = _read_all(ref).reshape(E, L)
+    tol = _grid_tol(mode, b)
+    assert (np.abs(a - b).max(axis=1) <= tol).all(), "post-quiesce drift"
+    # the EF residual map never exceeded its bound silently
+    assert sum(st.coldq.ef_evicted for st in srv.stores) == 0
+    srv.shutdown()
+    ref.shutdown()
+
+
+def test_fp16_exact_values_survive_cycles_bitwise(rng):
+    """The exact half of the contract: values on the fp16 grid move
+    through promote / demote / relocation cycles bit-identically."""
+    srv = _mk(True, hot_rows=16, tier_cold_dtype="fp16")
+    ref = _mk(False)
+    w, wr = srv.make_worker(0), ref.make_worker(0)
+    vals = rng.normal(size=(E, L)).astype(np.float16).astype(np.float32)
+    for ww in (w, wr):
+        ww.set(np.arange(E), vals)
+    for step in range(12):
+        srv.tier.promote_keys(rng.choice(E, 48, replace=False))
+        srv.tier.demote_keys(rng.choice(E, 48, replace=False))
+        srv.tier.maintain()
+        ks = rng.choice(E, 12, replace=False)
+        dest = int(rng.integers(0, srv.num_shards))
+        srv._relocate_to(ks, dest)
+        ref._relocate_to(ks, dest)
+        a, b = _read_all(srv), _read_all(ref)
+        assert np.array_equal(a, b), f"step {step}: fp16-exact drifted"
+        pk = rng.integers(0, E, 20)
+        assert np.array_equal(np.asarray(w.pull_sync(pk)),
+                              np.asarray(wr.pull_sync(pk)))
+    # no residuals were ever parked: everything was exact
+    assert sum(st.coldq.resid_rows() for st in srv.stores) == 0
+    srv.shutdown()
+    ref.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# delta-compressed sync (untiered): bytes, EF, exact flush
+# ---------------------------------------------------------------------------
+
+
+def _replicate(srv, w, n=48):
+    keys = np.arange(E)
+    ks = keys[srv.ab.owner[keys] != w.shard][:n]
+    w.intent(ks, 0, CLOCK_MAX)
+    srv.sync.run_round(force_intents=True, all_channels=True)
+    assert (srv.ab.cache_slot[w.shard, ks] >= 0).all()
+    return ks
+
+
+@pytest.mark.parametrize("mode", ["fp16", "int8"])
+def test_sync_compress_bytes_and_quiesce_exactness(rng, mode):
+    opts = dict(sync_max_per_sec=0, prefetch=False,
+                techniques=MgmtTechniques.REPLICATION_ONLY,
+                cache_slots_per_shard=64)
+    srv = adapm_tpu.setup(E, L, opts=SystemOptions(
+        sync_compress=mode, **opts))
+    ref = adapm_tpu.setup(E, L, opts=SystemOptions(**opts))
+    w, wr = srv.make_worker(0), ref.make_worker(0)
+    vals = rng.normal(size=(E, L)).astype(np.float32)
+    w.set(np.arange(E), vals)
+    wr.set(np.arange(E), vals)
+    ks = _replicate(srv, w)
+    kr = _replicate(ref, wr)
+    assert np.array_equal(ks, kr)
+    b0_shipped = sum(st.sync_bytes_shipped for st in srv.stores)
+    b0_full = sum(st.sync_bytes_full for st in srv.stores)
+    for _ in range(6):
+        v = rng.normal(size=(len(ks), L)).astype(np.float32)
+        w.push(ks, v)
+        wr.push(ks, v)
+        srv.sync.run_round(force_intents=True, all_channels=True)
+        ref.sync.run_round(force_intents=True, all_channels=True)
+        # read-your-writes through the parked residual: replica read =
+        # fresh + residual, within a grid step of the shadow
+        a = np.asarray(w.pull_sync(ks))
+        b = np.asarray(wr.pull_sync(ks))
+        tol = _grid_tol(mode, b.reshape(len(ks), L))
+        assert (np.abs(a - b).reshape(len(ks), L).max(axis=1)
+                <= tol).all()
+    shipped = sum(st.sync_bytes_shipped for st in srv.stores) - b0_shipped
+    full = sum(st.sync_bytes_full for st in srv.stores) - b0_full
+    assert full > 0
+    ratio = shipped / full
+    want = wire_bytes_per_row(mode, L) / (4 * L)
+    assert abs(ratio - want) < 1e-6, (ratio, want)
+    # the residual gauge saw the parked remainders
+    assert max(st.ef_residual_norm() for st in srv.stores) > 0.0
+    # quiesce flushes residuals EXACTLY (compression bypassed): the
+    # long-run sum is unbiased — only f32 merge-order rounding remains
+    srv.quiesce()
+    ref.quiesce()
+    a, b = _read_all(srv), _read_all(ref)
+    assert np.allclose(a, b, rtol=1e-6, atol=1e-6), (
+        f"post-quiesce max drift {np.abs(a - b).max():.3g}: the exact "
+        f"flush must leave no quantization bias behind")
+    srv.shutdown()
+    ref.shutdown()
+
+
+def test_sync_compress_off_is_pre_pr_path(rng):
+    """Defaults pin: with compress off, no compressed program ever
+    runs (no device residual scalar), and the byte accounting records
+    full-width rows — the pre-PR wire."""
+    srv = _mk(False, techniques=MgmtTechniques.REPLICATION_ONLY,
+              cache_slots_per_shard=64)
+    w = srv.make_worker(0)
+    w.set(np.arange(E), rng.normal(size=(E, L)).astype(np.float32))
+    ks = _replicate(srv, w)
+    w.push(ks, np.ones((len(ks), L), np.float32))
+    srv.sync.run_round(force_intents=True, all_channels=True)
+    st = srv.stores[0]
+    assert st._ef_resid_dev is None
+    assert st.ef_residual_norm() == 0.0
+    assert st.sync_bytes_shipped == st.sync_bytes_full > 0
+    snap = srv.metrics_snapshot()
+    assert snap["sync"]["ef_residual_norm"] == 0.0
+    assert snap["sync"]["bytes_per_round"] >= 0
+    srv.shutdown()
+
+
+def test_drop_flushes_residual_before_slot_free(rng):
+    """A replica dropped after compressed rounds must not lose its
+    parked residual: the drop path's flush bypasses compression, so
+    the owner ends at the true sum (not the quantized one)."""
+    opts = dict(sync_max_per_sec=0, prefetch=False,
+                techniques=MgmtTechniques.REPLICATION_ONLY,
+                cache_slots_per_shard=64)
+    srv = adapm_tpu.setup(E, L, opts=SystemOptions(
+        sync_compress="int8", **opts))
+    w = srv.make_worker(0)
+    w.set(np.arange(E), np.zeros((E, L), np.float32))
+    keys = np.arange(E)
+    k = keys[srv.ab.owner[keys] != w.shard][:1]
+    w.intent(k, 0, 3)
+    srv.sync.run_round(force_intents=True, all_channels=True)
+    assert srv.ab.cache_slot[w.shard, k[0]] >= 0
+    # a push whose int8 wire loses low bits: 100 + 0.05 off-grid
+    v = np.full((1, L), 100.0, np.float32)
+    v[0, 0] = 100.05
+    w.push(k, v)
+    srv.sync.run_round(force_intents=True, all_channels=True)  # compressed
+    # expire the intent -> the next rounds flush-and-drop the replica
+    for _ in range(8):
+        w.advance_clock()
+        srv.sync.run_round(force_intents=True, all_channels=True)
+    assert srv.ab.cache_slot[w.shard, k[0]] < 0, "replica not dropped"
+    got = np.asarray(srv.read_main(k)).reshape(L)
+    assert np.abs(got - v[0]).max() < 1e-4, (
+        f"residual lost on drop: {got[0]} vs {v[0, 0]}")
+    srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# beyond-HBM host-RAM contract (ISSUE 8 satellite)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("mode", ["fp32", "fp16"])
+def test_read_owned_bulk_no_second_full_table_copy(rng, mode):
+    """docs/MEMORY.md beyond-HBM contract, now actually tested: the
+    bulk read path (checkpoint/eval/export) must fancy-index the
+    requested rows out of the cold store — full-table f32 temporaries
+    beyond the returned rows themselves (e.g. a main_full_host()
+    assembly) would transiently double host RAM at exactly the scale
+    tiering exists for. Applies to the fp16 dequant path too: the wire
+    copy is half-width, dequantized straight into the output."""
+    E_big, L_big = 6000, 64
+    srv = adapm_tpu.setup(E_big, L_big, opts=SystemOptions(
+        sync_max_per_sec=0, prefetch=False, tier=True,
+        tier_hot_rows=64, tier_cold_dtype=mode))
+    w = srv.make_worker(0)
+    slab = 2000
+    for lo in range(0, E_big, slab):
+        w.set(np.arange(lo, lo + slab),
+              rng.normal(size=(slab, L_big)).astype(np.float32))
+    srv.block()
+    keys = np.arange(E_big)
+    table_bytes = E_big * L_big * 4
+    tracemalloc.start()
+    out = srv._read_owned_bulk(keys)
+    _, peak = tracemalloc.get_traced_memory()
+    tracemalloc.stop()
+    # budget: the flat output + the per-class row gather + the wire
+    # copy (<= half-width for fp16) + slack. A second full f32 table
+    # (the failure mode) adds another 1.0x and must trip this.
+    budget = (2.75 if mode == "fp32" else 3.25) * table_bytes
+    assert peak < budget, (
+        f"bulk read peaked at {peak / table_bytes:.2f}x the table "
+        f"({mode}); a full-table temporary has crept back in")
+    assert out.shape == (E_big * L_big,)
+    srv.shutdown()

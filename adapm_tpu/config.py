@@ -226,6 +226,24 @@ class SystemOptions:
     # IDENTICAL to the static-knob path (no controller exists).
     # Requires --sys.metrics (the controller reads the histogram).
     serve_slo_ms: float = 0.0
+    # dispatcher drains (ISSUE 9 tentpole b; serve/batcher.py): N
+    # admission lanes, each drained by its own executor stream
+    # (`serve`, `serve.1`, ...), so a long-row length class's gather no
+    # longer head-of-line-blocks short ones. Lanes are keyed by length
+    # class on multi-class servers, round-robin otherwise. 1 (the
+    # default) is the pre-PR single-consumer path, bit-identical.
+    serve_dispatchers: int = 1
+    # read-only serve replica (ISSUE 9 tentpole a; serve/replica.py):
+    # rows in the epoch-versioned snapshot of the hottest locally-owned
+    # rows. A lookup fully covered by a snapshot whose per-slot write
+    # epochs (and topology_version) are unchanged gathers WITHOUT the
+    # server lock — bit-identical to the locked path by construction;
+    # any staleness signal falls back to the exact path. 0 (default) =
+    # off: every lookup takes the pre-PR locked path.
+    serve_replica_rows: int = 0
+    # min interval between snapshot refreshes (the coalesced
+    # `serve_refresh` executor program's throttle), in ms
+    serve_replica_refresh_ms: float = 50.0
 
     # -- sampling (--sampling.*)
     sampling_scheme: str = "local"   # naive | preloc | pool | local
@@ -306,6 +324,22 @@ class SystemOptions:
                 f"--sys.exec.workers must be >= 1 "
                 f"(got {self.exec_workers}): the executor's streams "
                 f"need at least one worker to make progress")
+        if self.serve_dispatchers < 1:
+            raise ValueError(
+                f"--sys.serve.dispatchers must be >= 1 "
+                f"(got {self.serve_dispatchers}): the serve plane needs "
+                f"at least one dispatcher drain")
+        if self.serve_replica_rows < 0:
+            raise ValueError(
+                f"--sys.serve.replica_rows must be >= 0 "
+                f"(got {self.serve_replica_rows}; 0 = no read-only "
+                f"serve replica)")
+        if self.serve_replica_refresh_ms <= 0:
+            raise ValueError(
+                f"--sys.serve.replica_refresh_ms must be > 0 "
+                f"(got {self.serve_replica_refresh_ms}): a zero "
+                f"refresh throttle would let every snapshot miss queue "
+                f"an immediate refresh program")
         if self.serve_queue < self.serve_max_batch:
             raise ValueError(
                 f"inconsistent serve knobs: --sys.serve.queue "
@@ -410,6 +444,13 @@ class SystemOptions:
                        default=0.0)
         g.add_argument("--sys.serve.slo_ms", dest="sys_serve_slo_ms",
                        type=float, default=0.0)
+        g.add_argument("--sys.serve.dispatchers",
+                       dest="sys_serve_dispatchers", type=int, default=1)
+        g.add_argument("--sys.serve.replica_rows",
+                       dest="sys_serve_replica_rows", type=int, default=0)
+        g.add_argument("--sys.serve.replica_refresh_ms",
+                       dest="sys_serve_replica_refresh_ms", type=float,
+                       default=50.0)
         s = parser.add_argument_group("sampling")
         s.add_argument("--sampling.scheme", dest="sampling_scheme",
                        default="local",
@@ -472,6 +513,9 @@ class SystemOptions:
             serve_queue=args.sys_serve_queue,
             serve_deadline_ms=args.sys_serve_deadline_ms,
             serve_slo_ms=args.sys_serve_slo_ms,
+            serve_dispatchers=args.sys_serve_dispatchers,
+            serve_replica_rows=args.sys_serve_replica_rows,
+            serve_replica_refresh_ms=args.sys_serve_replica_refresh_ms,
             sampling_scheme=args.sampling_scheme,
             sampling_reuse_factor=args.sampling_reuse,
             sampling_pool_size=args.sampling_pool_size,

@@ -1440,8 +1440,20 @@ class Server:
         the tier section `tier.cold_bytes_per_row` (actual host bytes
         per cold row: dense store + scale column + parked residuals)
         plus the `tier.ef_resid_rows` / `tier.ef_evicted` residual-map
-        health pair."""
-        out: Dict = {"schema_version": 7,
+        health pair.
+
+        schema_version 8 (PR 9): the serve fast-path/tenancy surface
+        (ISSUE 9) — `serve.replica_hit_rate` (fraction of coalesced
+        batches served lock-free from the read-only replica snapshot),
+        `serve.replica_hits_total` / `serve.replica_refreshes_total` /
+        `serve.replica_stale_fallbacks_total` /  `serve.replica_rows`,
+        per-dispatcher `serve.lane_depth.<i>` gauges, and — once
+        tenants are configured — the per-tenant
+        `serve.tenant.<name>.{served,shed,rejected}_total` counters.
+        The readiness dict gains `dispatchers` /
+        `wedged_dispatchers`. All present-but-inert at the default
+        knobs (`--sys.serve.dispatchers 1`, no replica, no tenants)."""
+        out: Dict = {"schema_version": 8,
                      "metrics_enabled": bool(self.obs.enabled)}
         for s in self._SNAPSHOT_SECTIONS:
             out[s] = {}

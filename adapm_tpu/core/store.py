@@ -463,6 +463,28 @@ class ShardedStore:
         if m.any():
             self.main_epoch[owner_sh[m], owner_sl[m]] = self._next_epoch()
 
+    # -- write-epoch export (ISSUE 9; serve/replica.py) ----------------------
+
+    def export_epochs(self, o_sh: np.ndarray,
+                      o_sl: np.ndarray) -> np.ndarray:
+        """Copy of the main-row write epochs at (shard, slot) coords —
+        the serve replica records these under the server lock at
+        snapshot time. A row whose epoch later differs has (or may
+        have) a changed VALUE; promotions/demotions move rows without
+        changing them and deliberately do not bump."""
+        return self.main_epoch[o_sh, o_sl].copy()
+
+    def epochs_unchanged(self, o_sh: np.ndarray, o_sl: np.ndarray,
+                         epochs: np.ndarray) -> bool:
+        """True iff every (shard, slot) row's main epoch still equals
+        the exported value — the serve replica's read-your-writes /
+        staleness guard. Pure host read, safe without the lock: every
+        write path bumps the epoch cell BEFORE its device program is
+        enqueued (under the server lock), so a write that completed
+        before this check is always visible; a concurrent write that
+        is not yet visible linearizes after the lock-free read."""
+        return bool(np.array_equal(self.main_epoch[o_sh, o_sl], epochs))
+
     def _vals_bucket(self, vals, bucket: int):
         # numpy (uncommitted) for the same reason as pad_bucket: a device-0
         # committed array would be host-resharded by every mesh-jitted op

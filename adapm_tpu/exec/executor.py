@@ -338,6 +338,22 @@ class AsyncExecutor:
                         return False
                     self._cond.wait(min(rem, 0.5))
 
+    def drain_streams(self, streams, timeout: Optional[float] = None) \
+            -> bool:
+        """Drain several streams under ONE shared deadline (the serve
+        plane's N dispatcher streams must all quiesce within the same
+        bound at stop time — N sequential per-stream timeouts would
+        multiply the worst-case teardown wait). Returns False when the
+        deadline expires with any stream still busy."""
+        deadline = None if timeout is None else \
+            time.monotonic() + timeout
+        for name in streams:
+            rem = None if deadline is None else \
+                max(0.0, deadline - time.monotonic())
+            if not self.drain(name, timeout=rem):
+                return False
+        return True
+
     def close(self, timeout: float = 30.0) -> None:
         """Idempotent shutdown: cancel not-yet-started programs (their
         completions finish cancelled — no waiter hangs), let running

@@ -4,39 +4,53 @@ DLRM-style inference is dominated by the embedding lookup path, and a
 dedicated request-coalescing layer in front of the parameter store is
 the standard lever (GraphVite's batched sample/lookup pipeline,
 PAPERS.md; "Dissecting Embedding Bag Performance in DLRM Inference").
-The `LookupBatcher` dispatches as an event-driven drain program on the
-unified executor's `serve` stream (PR 6 — the dedicated dispatcher
-thread is subsumed by the executor pool; every `AdmissionQueue.submit`
-kicks a coalesced drain, and an idle plane owns no queued program). A
-drain
+The `LookupBatcher` dispatches as event-driven drain programs on the
+unified executor (PR 6 — the dedicated dispatcher thread is subsumed by
+the executor pool; every `AdmissionQueue.submit` kicks a coalesced
+drain for the request's lane, and an idle plane owns no queued
+program). ISSUE 9 shards the dispatch plane: `--sys.serve.dispatchers
+N` runs N drains on DISTINCT executor streams (`serve`, `serve.1`,
+...), one per admission lane, so a long-row length class's gather no
+longer head-of-line-blocks short ones; the queue's claim/shed state
+machine makes the N consumers exactly-once by construction. A drain
 
-  1. takes up to `--sys.serve.max_batch` requests from the admission
-     queue, lingering at most `--sys.serve.max_wait_us` after the first
+  1. takes up to `--sys.serve.max_batch` requests from its lane,
+     lingering at most `--sys.serve.max_wait_us` after the first
      (the micro-batch window — while a batch's gather is in flight the
      queue refills, so sustained load coalesces without waiting);
   2. DEDUPLICATES the union key set (concurrent clients hit the same hot
      rows; the device gathers one row per unique key, not per request);
-  3. dispatches ONE fused gather per length class through the exact
-     Pull machinery the training path uses — the routing-plan cache,
-     `Server._plan_pull`, and `Server._pull` under the server lock —
-     and scatters the union result back to each request.
+  3. serves the union from the READ-ONLY SERVE REPLICA when one is
+     attached (`--sys.serve.replica_rows`; serve/replica.py) and its
+     epoch-versioned snapshot fully covers the batch — no server lock,
+     no device dispatch, bit-identical by the epoch/topology
+     validation — otherwise dispatches ONE fused gather per length
+     class through the exact Pull machinery the training path uses —
+     the routing-plan cache, `Server._plan_pull`, and `Server._pull`
+     under the server lock — and scatters the union result back to
+     each request.
 
-Consistency contract (docs/SERVING.md): the plan is computed
-optimistically outside the lock against a `topology_version` snapshot
-and REVALIDATED under the lock at take time, exactly like `Worker.pull`
-(PR 1's staged-pull discipline); the per-class gathers are single
-device programs enqueued under the lock, so every key in a coalesced
-batch is read from the same pool state (no torn batches — a concurrent
-push is a whole program ordered before or after the gather, never
-interleaved). A serve lookup is therefore bit-identical to a plain
-`Worker.pull` of the same keys at the same point in dispatch order,
-across concurrent relocations and sync rounds (pinned by
-tests/test_serve.py's storm test).
+Consistency contract (docs/SERVING.md): the locked path's plan is
+computed optimistically outside the lock against a `topology_version`
+snapshot and REVALIDATED under the lock at take time, exactly like
+`Worker.pull` (PR 1's staged-pull discipline); the per-class gathers
+are single device programs enqueued under the lock, so every key in a
+coalesced batch is read from the same pool state (no torn batches — a
+concurrent push is a whole program ordered before or after the gather,
+never interleaved). The replica path keeps the same contract through
+its write-epoch validation (serve/replica.py module docstring): a
+batch carrying `after` ordering futures, an uncovered key, a moved
+topology, or any bumped epoch falls back to the locked path. A serve
+lookup is therefore bit-identical to a plain `Worker.pull` of the same
+keys at the same point in dispatch order, across concurrent
+relocations and sync rounds (pinned by tests/test_serve.py's storm
+tests, replica path included).
 """
 from __future__ import annotations
 
+import itertools
 import time
-from typing import List
+from typing import List, Optional
 
 import numpy as np
 
@@ -45,8 +59,8 @@ from .admission import AdmissionQueue, LookupRequest
 
 
 class LookupBatcher:
-    """Owns the dispatch logic (drain programs on the executor's
-    `serve` stream); one per ServePlane."""
+    """Owns the dispatch logic (drain programs on the per-lane executor
+    streams); one per ServePlane."""
 
     def __init__(self, server, opts, queue: AdmissionQueue,
                  shard: int = 0):
@@ -58,6 +72,28 @@ class LookupBatcher:
         # pools are one global sharded array, so any shard's rows are
         # one gather away in a single process)
         self.shard = int(shard)
+        # sharded dispatch (ISSUE 9): one drain stream per admission
+        # lane. Stream 0 keeps the historical name `serve` so existing
+        # drains/metrics/tooling see the single-dispatcher default
+        # unchanged.
+        self.dispatchers = max(1, int(getattr(opts, "serve_dispatchers",
+                                              1)))
+        self.streams = ["serve"] + [f"serve.{i}"
+                                    for i in range(1, self.dispatchers)]
+        # wall-clock start of the batch each dispatcher is currently
+        # serving (None = parked/idle). Written only by the owning
+        # drain; read lock-free by the health monitor's wedge probe.
+        self._busy_since: List[Optional[float]] = \
+            [None] * self.dispatchers
+        # lane assignment policy (ServeSession.lookup): by length class
+        # on multi-class servers (per-length-class program queues —
+        # long-row gathers stay off the short rows' stream), else
+        # round-robin so single-class load still spreads over N
+        self._rr = itertools.count()
+        # read-only serve replica (serve/replica.py); attached by
+        # ServePlane when --sys.serve.replica_rows > 0, else None (the
+        # fast path costs one attribute check)
+        self.replica = None
         # the EFFECTIVE micro-batch window: initialized from the static
         # knob and — only when --sys.serve.slo_ms is set — adapted by
         # the SLO controller (obs/slo.py) so tails track the target.
@@ -73,11 +109,39 @@ class LookupBatcher:
         self.c_keys = reg.counter("serve.keys_total", shared=True)
         self.c_keys_unique = reg.counter("serve.keys_deduped_total",
                                          shared=True)
+        # replica-path accounting (schema v8): batches served lock-free
+        # from the snapshot, and the hit-rate gauge the bench/guard
+        # quote (present-but-inert when no replica is attached)
+        self.c_replica_hits = reg.counter("serve.replica_hits_total",
+                                          shared=True)
+        if reg.enabled:
+            reg.gauge("serve.replica_hit_rate", shared=True,
+                      fn=self.replica_hit_rate)
         self.h_latency = reg.histogram("serve.latency_s",
                                        bounds=SERVE_LATENCY_BOUNDS_S,
                                        shared=True)
         self.h_batch = reg.histogram("serve.batch_size", unit="requests",
                                      bounds=BATCH_SIZE_BOUNDS, shared=True)
+
+    def replica_hit_rate(self) -> float:
+        """Fraction of coalesced batches served from the read-only
+        replica snapshot (0 with no replica attached)."""
+        b = float(self.c_batches.value)
+        return float(self.c_replica_hits.value) / b if b else 0.0
+
+    # -- lane assignment (called by ServeSession) ----------------------------
+
+    def assign_lane(self, keys: np.ndarray) -> int:
+        """Admission lane for a request: its length class on
+        multi-class servers (so each class's gathers queue on their own
+        stream), round-robin otherwise. With one dispatcher everything
+        is lane 0 — the pre-PR path."""
+        if self.dispatchers == 1:
+            return 0
+        srv = self.server
+        if len(srv.stores) > 1 and len(keys):
+            return int(srv.ab.key_class[keys[0]]) % self.dispatchers
+        return next(self._rr) % self.dispatchers
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -86,23 +150,25 @@ class LookupBatcher:
             return
         self._running = True
         self.queue.set_kick(self._kick)
-        self._kick()  # drain anything admitted before start
+        for lane in range(self.dispatchers):
+            self._kick(lane)  # drain anything admitted before start
 
     def stop(self) -> None:
         """Close the queue (failing queued requests loudly) and drain
-        the `serve` stream. A drain program that does not finish within
-        the bound is WEDGED (e.g. blocked on a dead remote owner's pull
-        future) and still reads through the server's pools — proceeding
-        into pool teardown would be a use-after-teardown, so this
-        fail-stops loudly instead (docs/failure_handling.md) and keeps
-        `_running` set (is_alive()/readiness stay truthful about the
-        live reader)."""
+        every dispatcher stream under ONE 30 s bound. A drain program
+        that does not finish within the bound is WEDGED (e.g. blocked
+        on a dead remote owner's pull future) and still reads through
+        the server's pools — proceeding into pool teardown would be a
+        use-after-teardown, so this fail-stops loudly instead
+        (docs/failure_handling.md) and keeps `_running` set
+        (is_alive()/readiness stay truthful about the live reader)."""
         self.queue.set_kick(None)
         self.queue.close()
         ex = self.server.exec
-        if not ex.closed and not ex.drain("serve", timeout=30):
+        if not ex.closed and not ex.drain_streams(self.streams,
+                                                  timeout=30):
             from ..utils import alog
-            alog("[serve] dispatcher failed to exit within 30s — "
+            alog("[serve] dispatcher(s) failed to exit within 30s — "
                  "wedged mid-dispatch (dead remote owner?)")
             raise RuntimeError(
                 "serve dispatcher wedged: did not exit within 30s "
@@ -115,21 +181,31 @@ class LookupBatcher:
         that runs the drain programs is still open."""
         return self._running and not self.server.exec.closed
 
-    # -- dispatcher ----------------------------------------------------------
+    def wedged_dispatchers(self, bound_s: float) -> List[int]:
+        """Dispatchers that have been serving ONE batch for longer than
+        `bound_s` (ISSUE 9 satellite: per-dispatcher liveness). Reads
+        the busy stamps lock-free — a wedged drain cannot be asked to
+        report, so readiness must never block on it."""
+        now = time.monotonic()
+        return [i for i, t in enumerate(self._busy_since)
+                if t is not None and now - t > bound_s]
 
-    def _kick(self) -> None:
-        """Queue one drain on the `serve` stream (coalesced: kicks
-        landing while a drain is queued are absorbed; a kick during a
-        RUNNING drain queues the next one, so no admitted request is
+    # -- dispatchers ---------------------------------------------------------
+
+    def _kick(self, lane: int = 0) -> None:
+        """Queue one drain for `lane` on its stream (coalesced: kicks
+        landing while that drain is queued are absorbed; a kick during
+        a RUNNING drain queues the next one, so no admitted request is
         ever left undrained)."""
         if self._running:
-            self.server.exec.submit("serve", self._drain,
-                                    label="serve.drain",
-                                    coalesce_key="serve.drain")
+            self.server.exec.submit(
+                self.streams[lane], lambda: self._drain(lane),
+                label=f"serve.drain.{lane}",
+                coalesce_key=f"serve.drain.{lane}")
 
-    def _drain(self) -> None:
-        """Serve micro-batches until the queue is empty (one executor
-        program; FIFO on the `serve` stream). The non-blocking take
+    def _drain(self, lane: int) -> None:
+        """Serve micro-batches until the lane is empty (one executor
+        program; FIFO on the lane's stream). The non-blocking take
         still LINGERS up to the micro-batch window after claiming a
         first request — that linger is the coalescing lever and counts
         as genuine stream-busy time."""
@@ -138,11 +214,29 @@ class LookupBatcher:
             # re-read per batch: the SLO controller adapts max_wait_us
             # between batches and the next window must honor it
             max_wait_s = self.max_wait_us * 1e-6
-            reqs = self.queue.take(max_batch, max_wait_s, block=False)
+            reqs = self.queue.take(max_batch, max_wait_s, block=False,
+                                   lane=lane)
             if not reqs:
                 return  # empty (or closed): park until the next kick
+            self._busy_since[lane] = time.monotonic()
             try:
                 self._serve_batch(reqs)
+            except (KeyboardInterrupt, SystemExit):
+                # interpreter/process teardown is NOT a request
+                # failure: shed the claimed batch so no waiter hangs,
+                # then PROPAGATE (ISSUE 9 satellite — recording these
+                # as request errors used to swallow the interrupt and
+                # keep the dispatcher serving)
+                for r in reqs:
+                    if not r._done.is_set():
+                        if r.tenant is not None:
+                            r.tenant.c_shed.inc()
+                        self.queue.c_shed.inc()
+                        r.fail(RuntimeError(
+                            "serve dispatcher interrupted "
+                            "(KeyboardInterrupt/SystemExit): claimed "
+                            "batch shed"))
+                raise
             except BaseException as e:  # noqa: BLE001 — the dispatcher
                 # must outlive any one batch: fail the batch's waiters
                 # loudly (never leave a claimed request undelivered) and
@@ -150,6 +244,8 @@ class LookupBatcher:
                 for r in reqs:
                     if not r._done.is_set():
                         r.fail(e)
+            finally:
+                self._busy_since[lane] = None
 
     def _serve_batch(self, reqs: List[LookupRequest]) -> None:
         srv = self.server
@@ -171,12 +267,38 @@ class LookupBatcher:
             # path either way; tier.serve_cold_keys counts them)
             srv.tier.note_serve(union)
         after = tuple(f for r in reqs for f in r.after)
-        try:
-            flat, t_enqueued = self._lookup_union(union, after)
-        except BaseException as e:  # noqa: BLE001 — fail every waiter
-            for r in reqs:
-                r.fail(e)
-            return
+        # read fast path (ISSUE 9): a batch with no cross-process write
+        # ordering may be served lock-free from the replica snapshot;
+        # any validation failure inside try_serve falls back here
+        served = None
+        rep = self.replica
+        if rep is not None and not after:
+            served = rep.try_serve(union)
+        if served is not None:
+            flat, t_cutoff = served
+            self.c_replica_hits.inc()
+            # lock-free hit: no dispatch/device segment — the flight
+            # breakdown's enqueue stamp collapses onto the dispatch
+            # point; the freshness probe keeps the SNAPSHOT's
+            # under-lock stamp as its read-order cutoff (the served
+            # bits are exactly as fresh as the snapshot's gather)
+            t_enqueued = t_dispatch
+        else:
+            try:
+                flat, t_enqueued = self._lookup_union(union, after)
+                t_cutoff = t_enqueued
+            except (KeyboardInterrupt, SystemExit):
+                for r in reqs:
+                    if not r._done.is_set():
+                        r.fail(RuntimeError(
+                            "serve dispatcher interrupted "
+                            "(KeyboardInterrupt/SystemExit): claimed "
+                            "batch shed"))
+                raise  # _drain propagates (satellite fix)
+            except BaseException as e:  # noqa: BLE001 — fail every waiter
+                for r in reqs:
+                    r.fail(e)
+                return
         # scatter the deduplicated union back to each request's keys
         # (duplicates within a request fan out here, like Worker.pull)
         from ..parallel.pm import _offsets, _select_flat
@@ -194,9 +316,10 @@ class LookupBatcher:
                 t_dispatch, t_enqueued, now, n_requests=len(reqs),
                 n_keys=len(allk), n_unique=len(union))
             # freshness probe: this union is a servable read of any
-            # probed key whose push was enqueued before this gather
-            # (obs/flight.py; t_enqueued orders the two)
-            fl.freshness.note_read(union, t_enqueued)
+            # probed key whose push was enqueued before this gather —
+            # or, on the replica path, before the SNAPSHOT's gather
+            # (obs/flight.py; t_cutoff orders the two either way)
+            fl.freshness.note_read(union, t_cutoff)
         for r in reqs:
             pos = np.searchsorted(union, r.keys)
             if r.trace is not None:
@@ -204,6 +327,8 @@ class LookupBatcher:
             r.deliver(_select_flat(flat, offs_u, lens_u, pos))
             self.c_lookups.inc()
             self.c_keys.inc(len(r.keys))
+            if r.tenant is not None:
+                r.tenant.c_served.inc()
             self.h_latency.observe(now - r.t0)
 
     def _lookup_union(self, keys: np.ndarray, after):

@@ -8,8 +8,14 @@ may observe arbitrarily stale replicas of remotely-owned keys, and
 cross-process pulls may block on a dead owner) reports not-ready while
 continuing to serve in-flight and local traffic; nothing hangs.
 
-Readiness folds three signals:
-  - the dispatcher thread is running (a dead dispatcher serves nothing);
+Readiness folds four signals:
+  - the dispatch plane is running (a dead dispatcher serves nothing);
+  - no individual dispatcher of the N sharded drains is WEDGED — busy
+    on one micro-batch for longer than the wedge bound (the same 30 s
+    fail-stop bound `LookupBatcher.stop` uses). One stuck dispatcher
+    of N flips readiness even while the healthy ones keep serving: the
+    probe reads per-drain busy stamps lock-free, so it can never hang
+    behind the wedged drain it is reporting (ISSUE 9 satellite);
   - the admission queue is not saturated (depth < bound — a full queue
     is rejecting new work);
   - no peer's heartbeat has gone stale (`Server.dead_nodes`; empty when
@@ -31,10 +37,15 @@ class HealthMonitor:
     """Owned by a ServePlane; see module docstring."""
 
     def __init__(self, plane, max_age_s: float = 10.0,
-                 dead_nodes_fn: Optional[Callable[[], list]] = None):
+                 dead_nodes_fn: Optional[Callable[[], list]] = None,
+                 wedge_s: float = 30.0):
         self.plane = plane
         self.server = plane.server
         self.max_age_s = max_age_s
+        # per-dispatcher wedge bound: a drain busy on ONE batch longer
+        # than this is stuck (matches the stop()-time fail-stop bound;
+        # injectable for tests)
+        self.wedge_s = wedge_s
         # injectable for tests (and for deployments with an external
         # failure detector); default: the server's heartbeat-staleness
         # detection
@@ -77,15 +88,26 @@ class HealthMonitor:
     def liveness(self) -> Dict:
         """Process-is-up probe: cheap, no cross-process calls."""
         return {"alive": True,
-                "dispatcher_alive": self.plane.batcher.is_alive()}
+                "dispatcher_alive": self.plane.batcher.is_alive(),
+                "dispatchers": self.plane.batcher.dispatchers}
 
     def readiness(self) -> Dict:
         """Can this process take NEW serving traffic, and if not, why.
-        Always probes fresh (and refreshes the gauge cache)."""
+        Always probes fresh (and refreshes the gauge cache). Never
+        blocks: the wedge probe reads busy stamps, so a stuck
+        dispatcher flips the signal within the wedge bound instead of
+        hanging the probe behind it."""
         import time
         reasons: List[str] = []
-        if not self.plane.batcher.is_alive():
+        batcher = self.plane.batcher
+        if not batcher.is_alive():
             reasons.append("dispatcher thread not running")
+        wedged = batcher.wedged_dispatchers(self.wedge_s)
+        if wedged:
+            reasons.append(
+                f"dispatcher(s) {wedged} wedged: busy on one "
+                f"micro-batch > {self.wedge_s:.0f}s (fail-stop bound, "
+                f"docs/failure_handling.md)")
         depth = self.plane.queue.depth()   # live requests only
         bound = self.plane.queue.bound
         if depth >= bound:
@@ -98,6 +120,8 @@ class HealthMonitor:
                 f"docs/failure_handling.md): {dead}")
         out = {"ready": not reasons, "reasons": reasons,
                "dead_nodes": dead, "queue_depth": depth,
-               "queue_bound": bound}
+               "queue_bound": bound,
+               "dispatchers": batcher.dispatchers,
+               "wedged_dispatchers": wedged}
         self._cache = (time.monotonic(), out)
         return out

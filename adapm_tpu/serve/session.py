@@ -7,6 +7,16 @@ admission queue (raising `ServeOverloadError` under backpressure) and
 blocks until the coalescing dispatcher delivers the values or the
 deadline sheds the request.
 
+Tenancy (ISSUE 9): a session constructed with `tenant=` (a name) and
+optionally `priority=` stamps every lookup with that tenant's admission
+state — its token-bucket quota gates submit (`ServeOverloadError` when
+the bucket is dry), its priority class decides who sheds first under
+pressure and who the fair-share batch budget favors
+(serve/admission.py). `ServePlane.configure_tenant` sets the policy; a
+session naming an unconfigured tenant gets an unthrottled priority-0
+default. With no tenant the request is untenanted priority-0 — the
+pre-PR behavior, byte for byte.
+
 Read-your-writes: a session constructed with `worker=` belongs to a
 client that also pushes through that worker. Single-process, nothing is
 needed — a push lands its device program under the server lock before
@@ -46,10 +56,23 @@ _CLAIMED_GRACE_S = 30.0
 class ServeSession:
     """One client's handle; obtained from `ServePlane.session()`."""
 
-    def __init__(self, plane, worker=None):
+    def __init__(self, plane, worker=None, tenant=None, priority=None):
         self.plane = plane
         self.server = plane.server
         self.worker = worker
+        self.tenant = plane.queue.tenant(tenant) \
+            if tenant is not None else None
+        # explicit priority overrides the tenant's class; None defers
+        # to the tenant's CURRENT priority at each lookup, so a live
+        # configure_tenant() re-class reaches existing sessions
+        # (untenanted default: 0, the pre-tenancy behavior)
+        self._priority = None if priority is None else int(priority)
+
+    @property
+    def priority(self) -> int:
+        if self._priority is not None:
+            return self._priority
+        return self.tenant.priority if self.tenant is not None else 0
 
     def lookup(self, keys, deadline_ms: Optional[float] = None,
                out: Optional[np.ndarray] = None) -> np.ndarray:
@@ -87,7 +110,9 @@ class ServeSession:
         fl = srv.flight
         tr = fl.mint() if fl is not None else None
         req = LookupRequest(keys, after=after, deadline_s=deadline_s,
-                            trace=tr)
+                            trace=tr, tenant=self.tenant,
+                            priority=self.priority,
+                            lane=self.plane.batcher.assign_lane(keys))
         try:
             self.plane.queue.submit(req)  # may raise ServeOverloadError
             if not req.wait(deadline_s):
@@ -95,6 +120,8 @@ class ServeSession:
                 # unclaimed
                 if req.try_shed():
                     self.plane.queue.c_shed.inc()
+                    if self.tenant is not None:
+                        self.tenant.c_shed.inc()
                     raise DeadlineExceededError(
                         f"lookup deadline ({deadline_ms} ms) expired "
                         f"before a micro-batch claimed the request "

@@ -262,6 +262,28 @@ class TierManager:
         if kicked:
             self.engine.kick()
 
+    def export_serve_scores(self) -> np.ndarray:
+        """Per-KEY residency access scores (ISSUE 9; serve/replica.py
+        seeds its hot-row selection from these fused with its own
+        `note_serve`-style load counters). Locally-owned keys map to
+        their owner row's decayed CLOCK score; process-remote keys read
+        0. Advisory host read — scores are racy by design (module
+        docstring), and a slightly stale score only shifts the
+        selection, never a served value. O(num_keys); refresh-frequency
+        only."""
+        srv = self.server
+        ab = srv.ab
+        out = np.zeros(srv.num_keys, dtype=np.int64)
+        single = len(srv.stores) == 1
+        for cid, st in enumerate(srv.stores):
+            owned = ab.owner >= 0
+            if not single:
+                owned = owned & (ab.key_class == cid)
+            k = np.nonzero(owned)[0]
+            if len(k):
+                out[k] = st.res.score[ab.owner[k], ab.slot[k]]
+        return out
+
     # -- synchronous promotion (fused runners; caller holds server lock) ----
 
     def pin_step_keys(self, role_class: Dict[str, int],

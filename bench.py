@@ -667,6 +667,121 @@ def bench_serve(E=20_000, vlen=32, clients=32, lookups_per_client=40,
     # section are filled from the open plane, close() empties them
     snap = srv.metrics_snapshot()
     plane2.close()
+
+    # -- mixed-tenant open-loop segment (ISSUE 9): 2 tenants at skewed
+    # priorities + the read-only replica fast path, under CONCURRENT
+    # training pushes. gold (priority 2) paces a fixed arrival rate on
+    # a hot working set the snapshot covers; bronze (priority 0)
+    # floods uniformly with a short deadline; one pusher hammers
+    # disjoint keys through the server lock the whole time. The
+    # artifact carries per-tenant qps/P99/shed and replica_hit_rate
+    # next to the closed-loop numbers above.
+    _progress("serve phase: mixed-tenant open-loop segment")
+    srv.flight = None
+    srv.opts.serve_slo_ms = 0.0
+    srv.opts.serve_max_wait_us = 200   # undo the SLO segment's 4x window
+    srv.opts.serve_dispatchers = 2
+    srv.opts.serve_replica_rows = 1024
+    srv.opts.serve_replica_refresh_ms = 10.0
+    plane3 = ServePlane(srv)
+    plane3.configure_tenant("gold", priority=2)
+    plane3.configure_tenant("bronze", priority=0)
+    hot = np.arange(512, dtype=np.int64)
+    warm_sess = plane3.session(tenant="gold")
+    warm_sess.lookup(hot)   # score the whole working set
+    plane3.replica.refresh_now()
+    h0r = srv.obs.find("serve.replica_hits_total").value
+    b0r = srv.obs.find("serve.batches_total").value
+    stop3 = threading.Event()
+    errs3: list = []
+    gold_lat: list = []
+    bronze_done = [0, 0]        # served, shed/rejected (client-side)
+    t_seg = 2.5
+
+    def t_pusher():
+        prng = np.random.default_rng(60)
+        ks_all = np.arange(2048, E, dtype=np.int64)
+        try:
+            while not stop3.is_set():
+                ks = np.unique(prng.choice(ks_all, 128))
+                w.push(ks, np.ones((len(ks), vlen), np.float32))
+        except BaseException as e:  # noqa: BLE001
+            errs3.append(e)
+
+    def t_gold():
+        prng = np.random.default_rng(61)
+        sess = plane3.session(tenant="gold")
+        try:
+            while not stop3.is_set():
+                t0g = time.perf_counter()
+                try:
+                    sess.lookup(prng.choice(hot, B), deadline_ms=1000.0)
+                    gold_lat.append(time.perf_counter() - t0g)
+                except (DeadlineExceededError, ServeOverloadError):
+                    pass
+                time.sleep(0.008)   # the paced open-loop arrival rate
+        except BaseException as e:  # noqa: BLE001
+            errs3.append(e)
+
+    def t_bronze(ci):
+        prng = np.random.default_rng(62 + ci)
+        sess = plane3.session(tenant="bronze")
+        try:
+            while not stop3.is_set():
+                try:
+                    sess.lookup(prng.integers(0, E, B), deadline_ms=10.0)
+                    bronze_done[0] += 1
+                except (DeadlineExceededError, ServeOverloadError):
+                    bronze_done[1] += 1
+        except BaseException as e:  # noqa: BLE001
+            errs3.append(e)
+
+    t3 = [threading.Thread(target=t_pusher),
+          threading.Thread(target=t_gold)] + \
+         [threading.Thread(target=t_bronze, args=(ci,))
+          for ci in range(4)]
+    for t in t3:
+        t.start()
+    time.sleep(t_seg)
+    stop3.set()
+    for t in t3:
+        t.join(timeout=60)
+    assert not errs3, errs3[:3]
+    gold_lat.sort()
+    gold_ten = plane3.queue.tenant("gold")
+    bronze_ten = plane3.queue.tenant("bronze")
+    hits_d = srv.obs.find("serve.replica_hits_total").value - h0r
+    batches_d = srv.obs.find("serve.batches_total").value - b0r
+    tenant_out = {
+        "seconds": t_seg,
+        # segment-windowed (the serve.replica_hit_rate gauge is
+        # cumulative over the server's life and would be diluted by
+        # the closed-loop phases above)
+        "replica_hit_rate": round(hits_d / max(1.0, batches_d), 4),
+        "gold": {
+            "priority": 2,
+            "qps": round(len(gold_lat) / t_seg, 1),
+            "p50_ms": round(1e3 * gold_lat[len(gold_lat) // 2], 3)
+            if gold_lat else None,
+            "p99_ms": round(
+                1e3 * gold_lat[max(0, int(0.99 * len(gold_lat)) - 1)],
+                3) if gold_lat else None,
+            "served": int(gold_ten.c_served.value),
+            "shed": int(gold_ten.c_shed.value +
+                        gold_ten.c_rejected.value)},
+        "bronze": {
+            "priority": 0,
+            "qps": round(bronze_done[0] / t_seg, 1),
+            "served": int(bronze_ten.c_served.value),
+            "shed": int(bronze_ten.c_shed.value +
+                        bronze_ten.c_rejected.value)}}
+    plane3.close()
+    _progress(f"serve phase: mixed tenants — gold "
+              f"{tenant_out['gold']['qps']} qps p99 "
+              f"{tenant_out['gold']['p99_ms']} ms / bronze "
+              f"{tenant_out['bronze']['qps']} qps "
+              f"{tenant_out['bronze']['shed']} shed; replica_hit_rate "
+              f"{tenant_out['replica_hit_rate']}")
     _progress(f"serve phase: {qps:.0f} qps coalesced vs {seq_qps:.0f} "
               f"sequential, {shed} shed under overload; slo p99 "
               f"{achieved_p99_ms:.1f} ms vs {slo_target_ms:.0f} ms "
@@ -695,11 +810,14 @@ def bench_serve(E=20_000, vlen=32, clients=32, lookups_per_client=40,
            # one sampled request's queue/batch/dispatch/device split
            # (ms) — where a lookup's time went (obs/flight.py)
            "flight_exemplar": exemplar,
+           # the mixed-tenant open-loop segment (ISSUE 9): per-tenant
+           # qps/P99/shed under concurrent training pushes, and the
+           # fraction of batches the read-only replica served lock-free
+           "tenants": tenant_out,
            "metrics": snap}
-    # detach the tracer before shutdown: the exemplar + flight section
-    # are already in the artifact, and a shutdown export would drop a
-    # flight.<rank>.trace.json into the working directory
-    srv.flight = None
+    # the tracer was already detached before the tenant segment; a
+    # shutdown export would otherwise drop a flight.<rank>.trace.json
+    # into the working directory
     srv.shutdown()
     return out
 

@@ -338,7 +338,7 @@ def test_serve_snapshot_section_and_lifecycle(ctx):
     _seed(w)
     # before any plane: the section exists (schema stability) but is {}
     snap = s.metrics_snapshot()
-    assert snap["schema_version"] == 7 and snap["serve"] == {}
+    assert snap["schema_version"] == 8 and snap["serve"] == {}
     plane = ServePlane(s)
     # one live plane per server
     with pytest.raises(RuntimeError):
@@ -409,5 +409,404 @@ def test_serve_default_deadline_from_opts(ctx):
     plane.start()
     t.join(timeout=30)
     assert not t.is_alive() and len(req_served) == 1
+    plane.close()
+    s.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 9: read-only serve replicas, sharded dispatch, tenant-aware admission
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("tiered", [False, True])
+def test_replica_storm_bit_identical(ctx, tiered):
+    """THE r9 acceptance storm extended to the replica path (ISSUE 9):
+    randomized push/set/relocate/sync/replica-churn (+ tier
+    promote/demote when tiered) with the read-only snapshot refreshed
+    mid-storm — every lookup bit-identical to `Worker.pull` of the same
+    keys, including snapshot-stale fallbacks (a bumped write epoch or a
+    moved topology forces the exact locked path) and same-session
+    read-your-writes. Asserts the fast path actually fired (hits > 0)
+    AND actually fell back (stale fallbacks > 0), so neither branch is
+    vacuously green."""
+    opts = SystemOptions(sync_max_per_sec=0, cache_slots_per_shard=64,
+                         serve_replica_rows=48,
+                         serve_replica_refresh_ms=1.0)
+    if tiered:
+        opts.tier = True
+        opts.tier_hot_rows = 8   # force a live cold path under the storm
+    s = make_server(ctx, opts=opts)
+    w0 = s.make_worker(0)   # shard 0 — the serve plane's shard
+    w1 = s.make_worker(1)   # shard 1 — a second writer + replica holder
+    _seed(w0)
+    plane = ServePlane(s)
+    sess = plane.session(worker=w0)
+    rep = plane.replica
+    assert rep is not None
+    hot = np.arange(24)     # the working set the snapshot should cover
+    # deterministic warm-up: build serve-load scores, snapshot, and pin
+    # the first replica-path hit + the first epoch-staleness fallback
+    assert np.array_equal(sess.lookup(hot), w0.pull_sync(hot))
+    assert rep.refresh_now() > 0
+    h0 = s.obs.find("serve.replica_hits_total").value
+    assert np.array_equal(sess.lookup(hot), w0.pull_sync(hot))
+    assert s.obs.find("serve.replica_hits_total").value == h0 + 1
+    w0.wait(w0.push(hot[:2], np.ones((2, VL), np.float32)))
+    # the push bumped the rows' write epochs: the very next lookup must
+    # fall back to the locked path and still read its own write
+    assert np.array_equal(sess.lookup(hot), w0.pull_sync(hot))
+    assert s.obs.find("serve.replica_stale_fallbacks_total").value >= 1
+    rng = np.random.default_rng(7)
+    for step in range(50):
+        op = rng.integers(0, 7)
+        kset = np.unique(rng.integers(0, NK, rng.integers(1, 9)))
+        if op == 0:
+            w0.push(kset, rng.normal(size=(len(kset), VL))
+                    .astype(np.float32))
+        elif op == 1:
+            w1.push(kset, rng.normal(size=(len(kset), VL))
+                    .astype(np.float32))
+        elif op == 2:
+            w0.set(kset, rng.normal(size=(len(kset), VL))
+                   .astype(np.float32))
+        elif op == 3:
+            s._relocate_to(kset, int(rng.integers(0, s.num_shards)))
+        elif op == 4:
+            # replica churn: a short-lived intent window on shard 1
+            w1.intent(kset, w1.current_clock, w1.current_clock + 2)
+            with s._round_lock:
+                s.sync.run_round(force_intents=True, all_channels=True)
+            w1.advance_clock()
+        elif op == 5:
+            with s._round_lock:
+                s.sync.run_round(all_channels=True)
+        else:
+            if s.tier is not None:  # promotion/demotion churn (tiered)
+                s.tier.demote_keys(kset)
+                s.tier.promote_keys(kset[: len(kset) // 2 + 1])
+        if step % 6 == 0:
+            rep.refresh_now()   # mid-storm snapshot rebuilds
+        for batch in (np.concatenate([rng.integers(0, NK, 6),
+                                      rng.choice(hot, 6)]),
+                      hot):
+            got = sess.lookup(batch)
+            ref = w0.pull_sync(batch)
+            assert np.array_equal(got, ref), \
+                f"step {step} (op {op}) diverged"
+    assert s.obs.find("serve.replica_hits_total").value > h0
+    plane.close()
+    s.shutdown()
+
+
+def test_replica_mixed_length_classes(ctx):
+    """Replica-path hits across length classes assemble the ragged flat
+    result exactly like the locked path."""
+    lens = np.where(np.arange(32) % 3 == 0, 8, 4)
+    opts = SystemOptions(sync_max_per_sec=0, serve_replica_rows=32,
+                         serve_replica_refresh_ms=1.0)
+    s = Server(32, lens, opts=opts, ctx=ctx)
+    w = s.make_worker(0)
+    flat = np.arange(lens.sum(), dtype=np.float32)
+    w.wait(w.set(np.arange(32), flat))
+    with ServePlane(s) as plane:
+        sess = plane.session()
+        batch = np.array([0, 1, 3, 6, 2, 0])  # mixed classes + duplicate
+        ref = w.pull_sync(batch)
+        assert np.array_equal(sess.lookup(batch), ref)
+        assert plane.replica.refresh_now() > 0
+        h0 = s.obs.find("serve.replica_hits_total").value
+        assert np.array_equal(sess.lookup(batch), ref)
+        assert s.obs.find("serve.replica_hits_total").value == h0 + 1
+    s.shutdown()
+
+
+def test_multi_consumer_take_exactly_once(ctx):
+    """N concurrent consumers on ONE queue claim disjoint request sets
+    (the claim/shed state machine is N-consumer safe — the property the
+    sharded dispatchers rely on), with client sheds racing the claims:
+    every request ends exactly one of claimed / shed, never both."""
+    from adapm_tpu.serve.admission import AdmissionQueue
+    q = AdmissionQueue(1024)
+    reqs = [LookupRequest(np.array([i])) for i in range(300)]
+    for r in reqs:
+        q.submit(r)
+    # a racing client sheds a third of them while consumers claim
+    shed_set = [r for i, r in enumerate(reqs) if i % 3 == 0]
+    claimed = [[] for _ in range(4)]
+    errs = []
+
+    def consumer(ci):
+        try:
+            while True:
+                batch = q.take(7, 0.0, block=False)
+                if not batch:
+                    return
+                claimed[ci].extend(batch)
+        except BaseException as e:  # noqa: BLE001
+            errs.append(e)
+
+    def shedder():
+        try:
+            for r in shed_set:
+                r.try_shed()
+        except BaseException as e:  # noqa: BLE001
+            errs.append(e)
+
+    threads = [threading.Thread(target=consumer, args=(ci,))
+               for ci in range(4)] + [threading.Thread(target=shedder)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+        assert not t.is_alive()
+    assert not errs, errs[:3]
+    got = [int(r.keys[0]) for c in claimed for r in c]
+    assert len(got) == len(set(got)), "a request was claimed twice"
+    for r in reqs:  # exactly one terminal state each
+        assert r.claimed != (r._state == 2), int(r.keys[0])
+    assert q.depth() == 0
+
+
+def test_admission_priority_preemption_and_compaction_race(ctx):
+    """ISSUE 9 satellite: at a full queue a higher-priority submission
+    preempts (sheds) the lowest-priority pending request instead of
+    being rejected; bound accounting stays exact while low-priority
+    corpses are compacted out under a racing high-priority take."""
+    from adapm_tpu.serve.admission import AdmissionQueue
+    q = AdmissionQueue(8)
+    lo = q.configure_tenant("lo", priority=0)
+    hi = q.configure_tenant("hi", priority=2)
+    lows = [LookupRequest(np.array([i]), tenant=lo, priority=0)
+            for i in range(8)]
+    for r in lows:
+        q.submit(r)
+    assert q.depth() == 8
+    # same-priority submission at bound: plain rejection (no preemption
+    # of an equal class)
+    with pytest.raises(ServeOverloadError):
+        q.submit(LookupRequest(np.array([90]), tenant=lo, priority=0))
+    assert lo.c_rejected.value == 1
+    # higher priority preempts: one low sheds loudly, the high admits
+    h0 = LookupRequest(np.array([91]), tenant=hi, priority=2)
+    q.submit(h0)
+    assert q.depth() == 8          # bound exact: 7 lows + 1 high
+    shed = [r for r in lows if r._done.is_set()]
+    assert len(shed) == 1 and lo.c_shed.value == 1
+    with pytest.raises(ServeOverloadError):
+        shed[0].take_result()
+    # fair-share take: the high-priority request is claimed FIRST even
+    # though it arrived last (no FIFO starvation under pressure)
+    batch = q.take(3, 0.0, block=False)
+    assert batch[0] is h0
+    # racing segment: a taker drains while high-priority submissions
+    # keep preempting/admitting — conservation must hold exactly
+    taken = list(batch)
+    stop = threading.Event()
+    errs = []
+
+    def taker():
+        try:
+            while not stop.is_set():
+                taken.extend(q.take(2, 0.0, block=False))
+        except BaseException as e:  # noqa: BLE001
+            errs.append(e)
+
+    t = threading.Thread(target=taker)
+    t.start()
+    highs = []
+    rejected = 0
+    for i in range(64):
+        r = LookupRequest(np.array([100 + i]), tenant=hi, priority=2)
+        try:
+            q.submit(r)
+            highs.append(r)
+        except ServeOverloadError:
+            rejected += 1
+    time.sleep(0.05)
+    stop.set()
+    t.join(timeout=30)
+    assert not t.is_alive()
+    taken.extend(q.take(64, 0.0, block=False))
+    assert not errs, errs[:3]
+    # exact accounting: every admitted request is exactly one of
+    # claimed / shed; nothing lost, nothing double-counted
+    for r in lows + [h0] + highs:
+        assert r.claimed != (r._state == 2), int(r.keys[0])
+    n_shed = sum(1 for r in lows + [h0] + highs if r._state == 2)
+    assert len(taken) + n_shed == len(lows) + 1 + len(highs)
+    assert len(set(id(r) for r in taken)) == len(taken)
+    assert q.depth() == 0
+
+
+def test_tenant_quota_and_fair_share(ctx):
+    """Token-bucket quotas reject at submit (quota backpressure, typed
+    + counted per tenant); batch formation serves the higher priority
+    class first and fair-shares slots across tenants within a class."""
+    s = make_server(ctx)
+    w = s.make_worker(0)
+    vals = _seed(w)
+    plane = ServePlane(s, start=False)
+    bz = plane.configure_tenant("bronze", priority=0, qps=0.5, burst=2)
+    plane.configure_tenant("gold", priority=1)
+    gold = plane.queue.tenant("gold")
+    silver = plane.configure_tenant("silver", priority=1)
+    # bronze burst=2: two admits, third rejects on the dry bucket
+    b1 = LookupRequest(np.array([1]), tenant=bz)
+    b2 = LookupRequest(np.array([2]), tenant=bz)
+    plane.queue.submit(b1)
+    plane.queue.submit(b2)
+    with pytest.raises(ServeOverloadError):
+        plane.queue.submit(LookupRequest(np.array([3]), tenant=bz))
+    assert bz.c_rejected.value == 1
+    # queue now: bronze, bronze; add gold+silver (priority 1) — a
+    # 4-slot batch claims the priority-1 class first, round-robin
+    # across gold/silver, and stays PRIORITY-PURE (bronze keys must
+    # not ride the high class's union gather); the next take serves
+    # the bronzes
+    g1 = LookupRequest(np.array([4]), tenant=gold, priority=1)
+    g2 = LookupRequest(np.array([5]), tenant=gold, priority=1)
+    s1 = LookupRequest(np.array([6]), tenant=silver, priority=1)
+    for r in (g1, g2, s1):
+        plane.queue.submit(r)
+    batch = plane.queue.take(4, 0.0, block=False)
+    assert [int(r.priority) for r in batch] == [1, 1, 1]
+    assert {r.tenant.name for r in batch[:2]} == {"gold", "silver"}, \
+        "fair share must alternate tenants within the priority class"
+    batch2 = plane.queue.take(4, 0.0, block=False)
+    assert set(batch2) == {b1, b2}
+    # end to end: a started plane serves tenant sessions and counts
+    # per-tenant serves in the snapshot (schema v8)
+    plane.start()
+    sess = plane.session(tenant="gold")
+    assert np.array_equal(sess.lookup(np.array([7]))[0], vals[7])
+    snap = s.metrics_snapshot()
+    assert snap["serve"]["tenant.gold.served_total"] >= 1
+    assert snap["serve"]["tenant.bronze.rejected_total"] == 1
+    plane.close()
+    s.shutdown()
+
+
+def test_sharded_dispatchers_serve_concurrently(ctx):
+    """--sys.serve.dispatchers N: N lanes on N executor streams serve
+    concurrent clients correctly (exactly-once, bit-identical), the
+    per-lane depth gauges exist (schema v8), and all N streams were
+    exercised."""
+    opts = SystemOptions(sync_max_per_sec=0, serve_dispatchers=3)
+    s = make_server(ctx, opts=opts)
+    w = s.make_worker(0)
+    vals = _seed(w)
+    plane = ServePlane(s)
+    errs = []
+
+    def client(ci):
+        try:
+            sess = plane.session()
+            rng = np.random.default_rng(ci)
+            for _ in range(20):
+                batch = rng.integers(0, NK, 8)
+                got = sess.lookup(batch)
+                if not np.array_equal(got, vals[batch]):
+                    errs.append((ci, batch))
+                    return
+        except BaseException as e:  # noqa: BLE001
+            errs.append(e)
+
+    threads = [threading.Thread(target=client, args=(ci,))
+               for ci in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+        assert not t.is_alive()
+    assert not errs, errs[:3]
+    snap = s.metrics_snapshot()
+    for i in range(3):
+        assert f"lane_depth.{i}" in snap["serve"]
+        assert snap["serve"][f"lane_depth.{i}"] == 0  # all drained
+    # round-robin lane assignment spread the load over every stream
+    assert "queue_depth.serve.1" in snap["exec"]
+    assert "queue_depth.serve.2" in snap["exec"]
+    assert snap["serve"]["lookups_total"] >= 120
+    plane.close()
+    s.shutdown()
+
+
+def test_wedged_dispatcher_flips_readiness(ctx):
+    """ISSUE 9 satellite: ONE wedged dispatcher of N flips
+    `serve.ready` within the wedge bound — the probe reads busy stamps
+    lock-free, never hanging behind the stuck drain — while the
+    healthy dispatchers keep serving; recovery clears the signal."""
+    opts = SystemOptions(sync_max_per_sec=0, serve_dispatchers=2)
+    s = make_server(ctx, opts=opts)
+    w = s.make_worker(0)
+    vals = _seed(w)
+    plane = ServePlane(s)
+    plane.health.wedge_s = 0.3   # injectable bound (default 30 s)
+    gate = threading.Event()
+    orig = plane.batcher._serve_batch
+
+    def stuck(reqs):
+        if any(int(r.keys[0]) == 77 for r in reqs):
+            gate.wait(30)   # the injected wedge
+        return orig(reqs)
+
+    plane.batcher._serve_batch = stuck
+    assert plane.health.readiness()["ready"]
+    wedge_req = LookupRequest(np.array([77]), lane=1)
+    plane.queue.submit(wedge_req)
+    deadline = time.monotonic() + 10
+    flipped = False
+    while time.monotonic() < deadline:
+        t0 = time.monotonic()
+        rd = plane.health.readiness()
+        assert time.monotonic() - t0 < 5.0, "readiness probe blocked"
+        if not rd["ready"] and rd["wedged_dispatchers"] == [1]:
+            assert any("wedged" in x for x in rd["reasons"])
+            flipped = True
+            break
+        time.sleep(0.02)
+    assert flipped, "wedged dispatcher did not flip readiness in bound"
+    assert s.metrics_snapshot()["serve"]["ready"] == 0
+    # the healthy dispatcher (lane 0) still serves while 1 is stuck
+    ok_req = LookupRequest(np.array([3]), lane=0)
+    plane.queue.submit(ok_req)
+    assert ok_req.wait(30)
+    assert np.array_equal(ok_req.take_result(), vals[3])
+    # release the wedge: the claimed request completes, ready recovers
+    gate.set()
+    assert wedge_req.wait(30)
+    assert np.array_equal(wedge_req.take_result(), vals[77])
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        rd = plane.health.readiness()
+        if rd["ready"] and rd["wedged_dispatchers"] == []:
+            break
+        time.sleep(0.02)
+    assert plane.health.readiness()["ready"]
+    plane.batcher._serve_batch = orig
+    plane.close()
+    s.shutdown()
+
+
+def test_dispatchers_one_no_tenants_is_r13_inert(ctx):
+    """Acceptance pin: the default knobs (--sys.serve.dispatchers 1, no
+    tenants, no replica) keep the single-consumer FIFO path and carry
+    the schema-v8 serve sections present-but-inert."""
+    s = make_server(ctx)
+    w = s.make_worker(0)
+    vals = _seed(w)
+    plane = ServePlane(s)
+    assert plane.batcher.dispatchers == 1
+    assert plane.replica is None
+    assert plane.queue.lanes == 1 and not plane.queue._has_qos
+    sess = plane.session()
+    assert np.array_equal(sess.lookup(np.array([5]))[0], vals[5])
+    snap = s.metrics_snapshot()
+    assert snap["serve"]["replica_hit_rate"] == 0.0
+    assert snap["serve"]["replica_hits_total"] == 0
+    assert snap["serve"]["lane_depth.0"] == 0
+    assert snap["serve"]["readiness"]["dispatchers"] == 1
+    assert snap["serve"]["readiness"]["wedged_dispatchers"] == []
+    assert not any(k.startswith("tenant.") for k in snap["serve"])
     plane.close()
     s.shutdown()

@@ -245,6 +245,35 @@ class SystemOptions:
     # `serve_refresh` executor program's throttle), in ms
     serve_replica_refresh_ms: float = 50.0
 
+    # -- fault injection + error policy (sys.fault.*; adapm_tpu/fault,
+    #    docs/failure_handling.md). The spec is `point=prob` pairs
+    #    (comma-separated), e.g. "sync.round=0.2,serve.drain=0.1" —
+    #    empty (the default) means NO FaultPlane exists: every
+    #    instrumented site pays one `is None` check and the registry
+    #    holds zero fault.* names (scripts/metrics_overhead_check.py).
+    fault_spec: str = ""
+    # seed for the per-point injection RNGs (deterministic drills)
+    fault_seed: int = 0
+    # executor error policy: bounded retries for TRANSIENT program
+    # failures (TransientFaultError classification — inert unless
+    # something raises it), exponential backoff from backoff_ms capped
+    # at backoff_max_ms
+    fault_retries: int = 3
+    fault_backoff_ms: float = 10.0
+    fault_backoff_max_ms: float = 2000.0
+    # per-program watchdog: an executor program busy past this marks
+    # its stream WEDGED (readiness escalation; never an interrupt —
+    # the waiters' own bounds fail-stop)
+    fault_watchdog_s: float = 30.0
+
+    # -- incremental checkpoints (sys.checkpoint.*; adapm_tpu/fault/
+    #    ckpt.py): every N seconds a `ckpt`-stream executor program
+    #    appends a dirty-slot delta (base first) to the chain at
+    #    checkpoint.path. 0 (default) = no periodic checkpointing;
+    #    explicit IncrementalCheckpointer use needs no knobs.
+    ckpt_every_s: float = 0.0
+    ckpt_path: Optional[str] = None
+
     # -- sampling (--sampling.*)
     sampling_scheme: str = "local"   # naive | preloc | pool | local
     sampling_reuse_factor: int = 32  # pool scheme
@@ -340,6 +369,37 @@ class SystemOptions:
                 f"(got {self.serve_replica_refresh_ms}): a zero "
                 f"refresh throttle would let every snapshot miss queue "
                 f"an immediate refresh program")
+        if self.fault_spec:
+            from .fault.inject import parse_fault_spec
+            parse_fault_spec(self.fault_spec)  # raises ValueError on a
+            # malformed point=prob entry or a probability outside [0,1]
+        if self.fault_seed < 0:
+            raise ValueError(
+                f"--sys.fault.seed must be >= 0 (got {self.fault_seed})")
+        if self.fault_retries < 0:
+            raise ValueError(
+                f"--sys.fault.retries must be >= 0 "
+                f"(got {self.fault_retries}; 0 = no retries, failures "
+                f"surface immediately)")
+        if self.fault_backoff_ms < 0 or self.fault_backoff_max_ms < 0:
+            raise ValueError(
+                f"--sys.fault.backoff_ms bounds must be >= 0 (got "
+                f"{self.fault_backoff_ms}/{self.fault_backoff_max_ms})")
+        if self.fault_watchdog_s <= 0:
+            raise ValueError(
+                f"--sys.fault.watchdog_s must be > 0 "
+                f"(got {self.fault_watchdog_s}): a zero watchdog would "
+                f"flag every program wedged the instant it starts")
+        if self.ckpt_every_s < 0:
+            raise ValueError(
+                f"--sys.checkpoint.every must be >= 0 "
+                f"(got {self.ckpt_every_s}; 0 = no periodic "
+                f"checkpointing)")
+        if self.ckpt_every_s > 0 and not self.ckpt_path:
+            raise ValueError(
+                "--sys.checkpoint.every requires --sys.checkpoint.path: "
+                "periodic incremental checkpoints need a chain "
+                "directory to append to")
         if self.serve_queue < self.serve_max_batch:
             raise ValueError(
                 f"inconsistent serve knobs: --sys.serve.queue "
@@ -451,6 +511,25 @@ class SystemOptions:
         g.add_argument("--sys.serve.replica_refresh_ms",
                        dest="sys_serve_replica_refresh_ms", type=float,
                        default=50.0)
+        g.add_argument("--sys.fault.spec", dest="sys_fault_spec",
+                       default="")
+        g.add_argument("--sys.fault.seed", dest="sys_fault_seed",
+                       type=int, default=0)
+        g.add_argument("--sys.fault.retries", dest="sys_fault_retries",
+                       type=int, default=3)
+        g.add_argument("--sys.fault.backoff_ms",
+                       dest="sys_fault_backoff_ms", type=float,
+                       default=10.0)
+        g.add_argument("--sys.fault.backoff_max_ms",
+                       dest="sys_fault_backoff_max_ms", type=float,
+                       default=2000.0)
+        g.add_argument("--sys.fault.watchdog_s",
+                       dest="sys_fault_watchdog_s", type=float,
+                       default=30.0)
+        g.add_argument("--sys.checkpoint.every",
+                       dest="sys_ckpt_every", type=float, default=0.0)
+        g.add_argument("--sys.checkpoint.path",
+                       dest="sys_ckpt_path", default=None)
         s = parser.add_argument_group("sampling")
         s.add_argument("--sampling.scheme", dest="sampling_scheme",
                        default="local",
@@ -516,6 +595,14 @@ class SystemOptions:
             serve_dispatchers=args.sys_serve_dispatchers,
             serve_replica_rows=args.sys_serve_replica_rows,
             serve_replica_refresh_ms=args.sys_serve_replica_refresh_ms,
+            fault_spec=args.sys_fault_spec,
+            fault_seed=args.sys_fault_seed,
+            fault_retries=args.sys_fault_retries,
+            fault_backoff_ms=args.sys_fault_backoff_ms,
+            fault_backoff_max_ms=args.sys_fault_backoff_max_ms,
+            fault_watchdog_s=args.sys_fault_watchdog_s,
+            ckpt_every_s=args.sys_ckpt_every,
+            ckpt_path=args.sys_ckpt_path,
             sampling_scheme=args.sampling_scheme,
             sampling_reuse_factor=args.sampling_reuse,
             sampling_pool_size=args.sampling_pool_size,

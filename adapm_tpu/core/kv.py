@@ -149,6 +149,33 @@ class Server:
         if self.opts.crash_dumps:
             from ..obs.flight import FlightRecorder
             self.flight_recorder = FlightRecorder(path=ring_path)
+        # fault-injection plane (ISSUE 10 tentpole; adapm_tpu/fault):
+        # None unless --sys.fault.spec names points — the r7 skip-
+        # wrapper discipline: off costs one `is None` check per
+        # instrumented site and zero fault.* registry names (pinned by
+        # scripts/metrics_overhead_check.py)
+        self.fault = None
+        if self.opts.fault_spec:
+            from ..fault.inject import FaultPlane
+            self.fault = FaultPlane(self.opts.fault_spec,
+                                    seed=self.opts.fault_seed,
+                                    registry=self.obs)
+        # executor error policy (fault/policy.py): bounded retry +
+        # exponential backoff for TRANSIENT program failures. Built
+        # unconditionally — the default classifier matches only
+        # TransientFaultError, so with nothing raising it the policy
+        # is inert and executor behavior is byte-identical to pre-PR.
+        from ..fault.policy import RetryPolicy
+        self._retry_policy = RetryPolicy(
+            max_retries=self.opts.fault_retries,
+            backoff_base_s=self.opts.fault_backoff_ms * 1e-3,
+            backoff_max_s=self.opts.fault_backoff_max_ms * 1e-3)
+        # degraded readiness (ISSUE 10): set while a checkpoint-chain
+        # restore applies (fault/ckpt.py restore_chain) — the serve
+        # plane sheds loudly with ServeDegradedError instead of
+        # risking a read that mixes pre- and post-restore bits
+        self._degraded_reason: Optional[str] = None
+        self._last_recovery_s: Optional[float] = None
         # unified async executor (ISSUE 6 tentpole; adapm_tpu/exec,
         # docs/EXECUTOR.md): THE ordered-stream dispatch plane under
         # sync rounds, prefetch staging, tier maintenance, serve
@@ -159,7 +186,9 @@ class Server:
         self.exec = AsyncExecutor(registry=self.obs,
                                   workers=self.opts.exec_workers,
                                   single_stream=self.opts.exec_single_stream,
-                                  recorder=self.flight_recorder)
+                                  recorder=self.flight_recorder,
+                                  retry_policy=self._retry_policy,
+                                  fault=self.fault)
 
         # kv-layer metrics: per-op latency histograms live on the
         # workers (kv.pull_s/push_s/set_s, shared); registry-side extras:
@@ -313,6 +342,20 @@ class Server:
             owners = self.ab.owner[traced]
             for s in np.unique(owners):
                 self.tracer.record(traced[owners == s], ALLOC, int(s))
+
+        # periodic incremental checkpoints (ISSUE 10; fault/ckpt.py):
+        # with --sys.checkpoint.every N + --sys.checkpoint.path D, a
+        # self-rescheduling `ckpt`-stream executor program appends a
+        # dirty-slot delta (base first) every N seconds. None when off.
+        self.ckpt = None
+        if self.opts.ckpt_every_s > 0:
+            if not self.opts.ckpt_path:
+                raise ValueError(
+                    "--sys.checkpoint.every requires "
+                    "--sys.checkpoint.path (chain directory)")
+            from ..fault.ckpt import IncrementalCheckpointer
+            self.ckpt = IncrementalCheckpointer(self, self.opts.ckpt_path)
+            self.ckpt.start_periodic(self.opts.ckpt_every_s)
 
         # periodic metrics reporter (--sys.metrics.report N). The import
         # is INSIDE the gate on purpose: with --sys.metrics 0 the
@@ -1076,7 +1119,8 @@ class Server:
         if self._sync_thread is not None:
             return
         self._sync_stop.clear()
-        state = {"last_report": _time.monotonic(), "last_rounds": 0}
+        state = {"last_report": _time.monotonic(), "last_rounds": 0,
+                 "fail_streak": 0}
         token = object()
         self._sync_thread = token
 
@@ -1084,24 +1128,53 @@ class Server:
             from ..utils import alog
             if self._sync_stop.is_set() or self._sync_thread is not token:
                 return
-            with self._round_lock:
-                self.sync.run_round()
-            # periodic report (reference SyncManager 10-second reports,
-            # sync_manager.h:482-497)
-            rs = self.opts.sync_report_s
-            now = _time.monotonic()
-            if rs > 0 and now - state["last_report"] >= rs:
-                dr = self.sync.stats.rounds - state["last_rounds"]
-                alog(f"[sync] "
-                     f"{dr / (now - state['last_report']):.1f} rounds/s | "
-                     + self.sync.report())
-                state["last_report"] = now
-                state["last_rounds"] = self.sync.stats.rounds
+            delay = 0.0
+            try:
+                if self.fault is not None:
+                    # ISSUE 10 injection point: fires BEFORE the round
+                    # does any work, so a retried tick re-runs cleanly
+                    self.fault.fire("sync.round")
+                with self._round_lock:
+                    self.sync.run_round()
+                state["fail_streak"] = 0
+                # periodic report (reference SyncManager 10-second
+                # reports, sync_manager.h:482-497)
+                rs = self.opts.sync_report_s
+                now = _time.monotonic()
+                if rs > 0 and now - state["last_report"] >= rs:
+                    dr = self.sync.stats.rounds - state["last_rounds"]
+                    alog(f"[sync] "
+                         f"{dr / (now - state['last_report']):.1f} "
+                         f"rounds/s | " + self.sync.report())
+                    state["last_report"] = now
+                    state["last_rounds"] = self.sync.stats.rounds
+            except Exception as e:  # noqa: BLE001 — the loop is
+                # IMMORTAL (ISSUE 10): a failed round — injected or
+                # real — reschedules with its own capped exponential
+                # backoff instead of dying with an error nobody waits
+                # on (the pre-PR failure mode: one transient tick
+                # failure silently killed background sync forever).
+                # Caught here rather than left to the executor's
+                # retry policy: the policy's budget is bounded, and a
+                # streak one longer than the budget must still not
+                # kill the loop — the tier maintenance pass and the
+                # periodic checkpointer follow the same pattern.
+                state["fail_streak"] += 1
+                delay = min(2.0, self.opts.fault_backoff_ms * 1e-3 *
+                            (2.0 ** min(state["fail_streak"], 10)))
+                if self.fault is not None:
+                    self.fault.c_loop_retries.inc()
+                alog(f"[sync] background round failed "
+                     f"(streak {state['fail_streak']}): "
+                     f"{type(e).__name__}: {e} — retrying in "
+                     f"{delay * 1e3:.0f} ms")
             if not self._sync_stop.is_set() and \
                     self._sync_thread is token:
-                self.exec.submit("sync", tick, label="sync.round")
+                self.exec.submit("sync", tick, label="sync.round",
+                                 coalesce_key="sync.round", delay=delay)
 
-        self.exec.submit("sync", tick, label="sync.round")
+        self.exec.submit("sync", tick, label="sync.round",
+                         coalesce_key="sync.round")
 
     def stop_sync_thread(self) -> None:
         if self._sync_thread is None:
@@ -1239,6 +1312,31 @@ class Server:
         from ..parallel import control
         return control.dead_processes(max_age_s)
 
+    # -- degraded readiness (ISSUE 10; fault/ckpt.py restore_chain) ----------
+
+    def begin_degraded(self, reason: str) -> None:
+        """Flip the server into DEGRADED state: the serve plane sheds
+        every lookup loudly with ServeDegradedError (session submit AND
+        dispatcher batch-serve both check), and readiness reports the
+        reason. Set by restore_chain around the chain apply; available
+        to operators for any maintenance window where reads must not
+        race a state mutation. A plain write — readers are lock-free:
+        a lookup that read None just before the flag flips linearizes
+        before the guarded mutation begins (nothing has changed yet),
+        which is a valid pre-window read."""
+        self._degraded_reason = str(reason)
+
+    def end_degraded(self) -> None:
+        self._degraded_reason = None
+
+    @property
+    def degraded(self) -> bool:
+        return self._degraded_reason is not None
+
+    @property
+    def degraded_reason(self) -> Optional[str]:
+        return self._degraded_reason
+
     def drive_rounds(self, n: int = 1) -> None:
         """One training step's planner-drive slot (the apps' per-step
         `sync.run_round` loop): inline when no prefetch pipeline, else
@@ -1261,13 +1359,16 @@ class Server:
           2. metrics reporter
           3. prefetch pipeline (staged gathers + delegated rounds)
           4. tier maintenance worker (demotion readbacks)
-          5. background sync rounds
-          6. the unified executor (every producer above is stopped, so
+          5. periodic checkpointer (an in-flight `ckpt` save reads
+             through the pools: its stream drains BEFORE teardown —
+             ISSUE 10 satellite)
+          6. background sync rounds
+          7. the unified executor (every producer above is stopped, so
              a well-ordered close cancels nothing; queued stragglers
              finish cancelled rather than dispatching into teardown)
-          7. pool quiesce (block) + sync channel executor
-          8. stats / trace / span export, registry unhook
-          9. cross-process layer
+          8. pool quiesce (block) + sync channel executor
+          9. stats / trace / span export, registry unhook
+         10. cross-process layer
 
         Idempotent: a second shutdown() is a no-op (each subordinate
         close is idempotent too, so a test that closed a plane manually
@@ -1286,6 +1387,8 @@ class Server:
             self.prefetch.close()
         if self.tier is not None:
             self.tier.close()
+        if self.ckpt is not None:
+            self.ckpt.close()
         self.stop_sync_thread()
         self.exec.close()
         self.block()
@@ -1375,7 +1478,8 @@ class Server:
     # metrics_snapshot() — the schema-stability contract tests pin
     _SNAPSHOT_SECTIONS = ("kv", "prefetch", "plan_cache", "staging",
                           "sync", "pm", "collective", "fused", "spans",
-                          "serve", "tier", "exec", "flight", "slo")
+                          "serve", "tier", "exec", "flight", "slo",
+                          "fault", "ckpt")
 
     def metrics_snapshot(self, drain_device: bool = True) -> Dict:
         """One structured, JSON-serializable telemetry dict for this
@@ -1452,8 +1556,21 @@ class Server:
         `serve.tenant.<name>.{served,shed,rejected}_total` counters.
         The readiness dict gains `dispatchers` /
         `wedged_dispatchers`. All present-but-inert at the default
-        knobs (`--sys.serve.dispatchers 1`, no replica, no tenants)."""
-        out: Dict = {"schema_version": 8,
+        knobs (`--sys.serve.dispatchers 1`, no replica, no tenants).
+
+        schema_version 9 (PR 10): always-present `fault` and `ckpt`
+        sections (ISSUE 10). `fault` — the injection plane's seed,
+        fired-injection totals and per-point eval/fire counts, plus
+        the executor error policy's retries / cumulative backoff
+        seconds and the watchdog's wedge-flip count; `{}` unless
+        `--sys.fault.spec` names points. `ckpt` — the incremental
+        checkpoint chain's save/base/delta counters, last link bytes
+        and dirty-slot count, cumulative bytes, and — once a
+        restore_chain ran on this server — `recovery_s`; `{}` unless a
+        periodic checkpointer is attached or a restore ran. The
+        readiness dict gains `degraded` (the restore-window shed
+        reason, None when healthy) and `wedged_streams`."""
+        out: Dict = {"schema_version": 9,
                      "metrics_enabled": bool(self.obs.enabled)}
         for s in self._SNAPSHOT_SECTIONS:
             out[s] = {}
@@ -1508,6 +1625,15 @@ class Server:
         if self._serve_plane is not None and \
                 self._serve_plane.slo is not None:
             out["slo"].update(self._serve_plane.slo.report())
+        # fault/ckpt (schema v9): populated only while the respective
+        # plane exists — the sections stay {} (never absent) otherwise
+        if self.fault is not None:
+            out["fault"].update(self.fault.stats())
+            out["fault"].update(self.exec.fault_stats())
+        if self.ckpt is not None:
+            out["ckpt"].update(self.ckpt.stats())
+        if self._last_recovery_s is not None:
+            out["ckpt"]["recovery_s"] = self._last_recovery_s
         if serve_ready is not None:
             # readiness detail rides with the serve.* gauges: dead peers
             # (Server.dead_nodes — detection-only), queue depth/bound,

@@ -111,7 +111,7 @@ def _done_completion(label: str = "") -> Completion:
 
 class _Program:
     __slots__ = ("fn", "label", "coalesce_key", "after", "not_before",
-                 "t_submit", "completion")
+                 "t_submit", "completion", "attempts")
 
     def __init__(self, fn, label, coalesce_key, after, not_before):
         self.fn = fn
@@ -121,6 +121,10 @@ class _Program:
         self.not_before = not_before
         self.t_submit = time.monotonic()
         self.completion = Completion(label)
+        # transient-failure retries consumed so far (fault/policy.py):
+        # the SAME program object re-queues at its stream head, so the
+        # completion stays open until the final outcome
+        self.attempts = 0
 
     def ready(self, now: float) -> bool:
         if self.not_before > now:
@@ -129,7 +133,7 @@ class _Program:
 
 
 class _Stream:
-    __slots__ = ("name", "q", "active")
+    __slots__ = ("name", "q", "active", "busy_since", "busy_label")
 
     def __init__(self, name: str):
         self.name = name
@@ -137,6 +141,13 @@ class _Stream:
         # active > 0 while a program of this stream executes (queued
         # ones hold exactly 1; inline `track` sections add theirs)
         self.active = 0
+        # wall-clock start + label of the QUEUED program currently
+        # executing (None = none). Written by the owning worker under
+        # _cond; the watchdog probe (wedged_streams) reads it to flag
+        # a program busy past --sys.fault.watchdog_s without ever
+        # blocking behind it.
+        self.busy_since = None
+        self.busy_label = None
 
 
 # ---------------------------------------------------------------------------
@@ -160,12 +171,27 @@ class AsyncExecutor:
 
     def __init__(self, registry=None, workers: int = 4,
                  single_stream: bool = False, name: str = "exec",
-                 recorder=None):
+                 recorder=None, retry_policy=None, fault=None):
         self.name = name
         # optional flight recorder (obs/flight.py, rides
         # --sys.crash_dumps): one ring append + pwrite per PROGRAM —
         # never per Pull/Push op, so the hot path never sees it
         self.recorder = recorder
+        # executor error policy (ISSUE 10; fault/policy.py): transient
+        # program failures re-queue at the head of their stream with
+        # bounded exponential backoff instead of killing the waiter /
+        # the subsystem's self-rescheduling loop. None (or the default
+        # classifier with nothing raising TransientFaultError) is
+        # byte-for-byte the pre-policy behavior.
+        self.retry_policy = retry_policy
+        # optional fault-injection plane (fault/inject.py): fires the
+        # exec.dispatch (retry-safe, before the program runs) and
+        # exec.complete (FATAL — the work already happened) points.
+        # None costs one attribute check per program, never per op.
+        self.fault = fault
+        # streams currently flagged wedged by the watchdog probe (the
+        # flip counter increments on the not-wedged -> wedged edge)
+        self._wedged_known: set = set()
         self.max_workers = 1 if single_stream else max(1, int(workers))
         self.single_stream = bool(single_stream)
         self._cond = threading.Condition()
@@ -198,6 +224,10 @@ class AsyncExecutor:
         else:
             self._c_programs = Counter("exec.programs_total")
             self._h_wait = Histogram("exec.dispatch_wait_s")
+        # watchdog flip counter: standalone on purpose — it reaches the
+        # snapshot through stats()/the fault section, and the registry
+        # must hold zero fault.* names when injection is off
+        self._c_wedge_flips = Counter("exec.wedge_flips")
 
     # -- accounting ----------------------------------------------------------
 
@@ -252,7 +282,54 @@ class AsyncExecutor:
                     "busy_s": single + over,
                     "overlap_s": over,
                     "overlap_fraction": over / (single + over)
-                    if (single + over) else 0.0}
+                    if (single + over) else 0.0,
+                    "retries": int(self.retry_policy.c_retries.value)
+                    if self.retry_policy is not None else 0,
+                    "wedge_flips": int(self._c_wedge_flips.value)}
+
+    def wedged_streams(self, bound_s: float,
+                       exclude=()) -> List[Dict]:
+        """Streams whose CURRENT program has been executing longer than
+        `bound_s` — the per-program watchdog (ISSUE 10): a wedged
+        program cannot be interrupted (its thread is stuck inside the
+        callable), but it can be NAMED, so readiness flips and waiters
+        fail-stop on their own bounds instead of the whole process
+        hanging silently. Reads the busy stamps under the executor
+        condvar (brief; the wedged program holds no executor lock while
+        running, so this probe never blocks behind it). Each
+        not-wedged -> wedged edge counts one wedge flip. `exclude`
+        names streams whose programs are LEGITIMATELY long-running
+        loops with their own finer-grained liveness probe (the serve
+        drains: one program serves batches until its lane empties, and
+        LookupBatcher.wedged_dispatchers bounds each BATCH instead)."""
+        now = time.monotonic()
+        out: List[Dict] = []
+        skip = set(exclude)
+        with self._cond:
+            for st in self._streams.values():
+                if st.name in skip:
+                    continue
+                t = st.busy_since
+                if t is not None and now - t > bound_s:
+                    out.append({"stream": st.name,
+                                "label": st.busy_label,
+                                "busy_s": now - t})
+                    if st.name not in self._wedged_known:
+                        self._wedged_known.add(st.name)
+                        self._c_wedge_flips.inc()
+                elif st.name in self._wedged_known and (
+                        t is None or now - t <= bound_s):
+                    self._wedged_known.discard(st.name)
+        return out
+
+    def fault_stats(self) -> Dict[str, float]:
+        """The executor's half of the `fault` snapshot section:
+        retry/backoff totals (fault/policy.py) + watchdog flips."""
+        out: Dict[str, float] = {
+            "wedge_flips": int(self._c_wedge_flips.value)}
+        if self.retry_policy is not None:
+            out.update(self.retry_policy.stats())
+        return out
 
     # -- submission ----------------------------------------------------------
 
@@ -454,6 +531,8 @@ class AsyncExecutor:
                 st.q.popleft()
                 self._stream_enter(st)
                 self._started += 1
+                st.busy_since = time.monotonic()
+                st.busy_label = prog.label
             self._c_programs.inc()
             t_run = time.monotonic()
             wait_s = t_run - prog.t_submit
@@ -461,7 +540,17 @@ class AsyncExecutor:
             result = None
             error = None
             try:
+                f = self.fault
+                if f is not None:
+                    # retry-safe point: fires BEFORE the program runs,
+                    # so a retried attempt re-executes from scratch
+                    f.fire("exec.dispatch")
                 result = prog.fn()
+                if f is not None:
+                    # completion-side point: the work already happened,
+                    # only the completion is lost — FATAL by
+                    # construction (a retry would double-execute)
+                    f.fire("exec.complete", transient=False)
             except BaseException as e:  # noqa: BLE001 — the pool must
                 # outlive any one program; the error reaches waiters
                 # via the completion and the log
@@ -473,9 +562,40 @@ class AsyncExecutor:
                 rec.record(st.name, prog.label, prog.coalesce_key,
                            wait_s, time.monotonic() - t_run,
                            failed=error is not None)
+            # error policy (fault/policy.py): a TRANSIENT failure with
+            # budget left re-queues the SAME program at its stream head
+            # (FIFO preserved) after an exponential backoff; the
+            # completion stays open until the final outcome
+            pol = self.retry_policy
+            if (error is not None and pol is not None
+                    and prog.attempts < pol.max_retries
+                    and pol.classify(error)):
+                prog.attempts += 1
+                delay = pol.backoff_s(prog.attempts)
+                pol.c_retries.inc()
+                pol.c_backoff_s.inc(delay)
+                alog(f"[exec] retrying {prog.label!r} on stream "
+                     f"{st.name!r} (attempt {prog.attempts}/"
+                     f"{pol.max_retries}, backoff {delay * 1e3:.0f} ms)")
+                with self._cond:
+                    self._stream_exit(st)
+                    self._finished += 1
+                    st.busy_since = None
+                    st.busy_label = None
+                    if self._closed:
+                        # teardown won the race: finish cancelled, no
+                        # waiter hangs on a retry that can never run
+                        prog.completion._finish(cancelled=True)
+                    else:
+                        prog.not_before = time.monotonic() + delay
+                        st.q.appendleft(prog)
+                    self._cond.notify_all()
+                continue
             with self._cond:
                 self._stream_exit(st)
                 self._finished += 1
+                st.busy_since = None
+                st.busy_label = None
                 self._cond.notify_all()
             prog.completion._finish(result, error)
 

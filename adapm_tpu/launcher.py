@@ -49,19 +49,39 @@ def make_env(rank: int, num: int, coordinator: str,
 
 
 def launch_local(n: int, cmd: List[str], keepalive: bool = True,
-                 coordinator: Optional[str] = None) -> int:
+                 coordinator: Optional[str] = None,
+                 max_restarts: int = 8,
+                 backoff_base_s: float = 0.5,
+                 backoff_max_s: float = 30.0) -> int:
     """Run n copies locally; returns the first nonzero exit code (0 if all
-    succeed). Keepalive restarts rank processes that exit with 254."""
+    succeed). Keepalive restarts rank processes that exit with 254 —
+    with CAPPED EXPONENTIAL BACKOFF and a max-restart budget (ISSUE 10
+    satellite: the reference dmlc_local.py contract restarts forever at
+    a fixed 0.5 s cadence, so a rank that crashes at startup hot-loops
+    indefinitely; here restart k waits min(backoff_base * 2^k,
+    backoff_max) and after `max_restarts` restarts the rank's 254 is
+    propagated as the job's failure code instead of looping)."""
     coordinator = coordinator or f"localhost:{free_port()}"
     codes = [0] * n
     threads = []
 
     def run(rank: int) -> None:
+        restarts = 0
         while True:
             p = subprocess.Popen(cmd, env=make_env(rank, n, coordinator))
             p.wait()
             if keepalive and p.returncode == KEEPALIVE_EXIT_CODE:
-                time.sleep(0.5)
+                if restarts >= max_restarts:
+                    print(f"[launcher] rank {rank} exhausted its "
+                          f"restart budget ({max_restarts}): crash "
+                          f"loop — giving up with exit code "
+                          f"{p.returncode}", file=sys.stderr)
+                    codes[rank] = p.returncode
+                    return
+                delay = min(backoff_max_s,
+                            backoff_base_s * (2.0 ** restarts))
+                restarts += 1
+                time.sleep(delay)
                 continue
             codes[rank] = p.returncode
             return
@@ -136,6 +156,12 @@ def main(argv=None) -> int:
     parser.add_argument("--coordinator-port", type=int, default=0,
                         help="pin the coordinator port (ssh/mpi modes)")
     parser.add_argument("--no-keepalive", action="store_true")
+    parser.add_argument("--max-restarts", type=int, default=8,
+                        help="local mode: keepalive restart budget per "
+                        "rank before a crash-looping 254 propagates")
+    parser.add_argument("--restart-backoff", type=float, default=0.5,
+                        help="local mode: base seconds of the capped "
+                        "exponential keepalive restart backoff")
     parser.add_argument("cmd", nargs=argparse.REMAINDER,
                         help="program to launch (prefix with --)")
     args = parser.parse_args(argv)
@@ -144,7 +170,9 @@ def main(argv=None) -> int:
         parser.error("no command given")
     if args.mode == "local":
         return launch_local(args.num_processes, cmd,
-                            keepalive=not args.no_keepalive)
+                            keepalive=not args.no_keepalive,
+                            max_restarts=args.max_restarts,
+                            backoff_base_s=args.restart_backoff)
     if args.mode == "ssh":
         with open(args.hostfile) as f:
             hosts = [h.strip() for h in f if h.strip()]

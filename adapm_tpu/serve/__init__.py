@@ -35,7 +35,8 @@ Quickstart::
 from __future__ import annotations
 
 from .admission import (AdmissionQueue, DeadlineExceededError,  # noqa: F401
-                        LookupRequest, ServeOverloadError, TenantState)
+                        LookupRequest, ServeDegradedError,
+                        ServeOverloadError, TenantState)
 from .batcher import LookupBatcher  # noqa: F401
 from .health import HealthMonitor  # noqa: F401
 from .replica import ServeReplica  # noqa: F401

@@ -75,6 +75,19 @@ class DeadlineExceededError(TimeoutError):
     `serve.shed_total`."""
 
 
+class ServeDegradedError(RuntimeError):
+    """The server is DEGRADED (a checkpoint-chain restore is applying —
+    fault/ckpt.py restore_chain — or an operator opened a maintenance
+    window with `Server.begin_degraded`): lookups are shed loudly with
+    this distinct error instead of risking a read that mixes pre- and
+    post-restore bits. Checked at session submit (fast rejection at
+    the door) AND at dispatcher batch-serve time (requests already
+    queued when the window opened). Counted in
+    `serve.degraded_shed_total`; the bit-identity contract holds —
+    a degraded server never returns a torn or stale value, it returns
+    THIS error (docs/failure_handling.md)."""
+
+
 _PENDING, _CLAIMED, _SHED = 0, 1, 2
 
 
@@ -271,6 +284,8 @@ class AdmissionQueue:
             self.c_rejected = registry.counter("serve.rejected_total",
                                                shared=True)
             self.c_shed = registry.counter("serve.shed_total", shared=True)
+            self.c_degraded = registry.counter(
+                "serve.degraded_shed_total", shared=True)
             registry.gauge("serve.queue_depth", fn=self.depth,
                            shared=True)
             for i in range(self.lanes):
@@ -282,6 +297,7 @@ class AdmissionQueue:
             # bookkeeping either way)
             self.c_rejected = Counter("serve.rejected_total")
             self.c_shed = Counter("serve.shed_total")
+            self.c_degraded = Counter("serve.degraded_shed_total")
 
     # -- tenancy -------------------------------------------------------------
 

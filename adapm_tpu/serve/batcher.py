@@ -55,7 +55,7 @@ from typing import List, Optional
 import numpy as np
 
 from ..obs.metrics import BATCH_SIZE_BOUNDS, SERVE_LATENCY_BOUNDS_S
-from .admission import AdmissionQueue, LookupRequest
+from .admission import AdmissionQueue, LookupRequest, ServeDegradedError
 
 
 class LookupBatcher:
@@ -209,6 +209,29 @@ class LookupBatcher:
         still LINGERS up to the micro-batch window after claiming a
         first request — that linger is the coalescing lever and counts
         as genuine stream-busy time."""
+        srv = self.server
+        if srv.fault is not None:
+            # ISSUE 10 injection point: fires BEFORE any request is
+            # claimed, so a failed drain program sheds nobody — the
+            # executor's retry policy re-runs the drain and every
+            # admitted request is still served
+            try:
+                srv.fault.fire("serve.drain")
+            except BaseException:
+                # re-kick the lane FIRST (coalesced, short delay):
+                # kicks that landed while this program was queued were
+                # absorbed into it, so if the executor's retry budget
+                # exhausts and this program dies, the follow-up drain
+                # queued here still serves every admitted request — a
+                # no-deadline lookup must never hang on a dead drain
+                if self._running:
+                    srv.exec.submit(
+                        self.streams[lane],
+                        lambda: self._drain(lane),
+                        label=f"serve.drain.{lane}",
+                        coalesce_key=f"serve.drain.{lane}",
+                        delay=0.02)
+                raise
         max_batch = self.opts.serve_max_batch
         while True:
             # re-read per batch: the SLO controller adapts max_wait_us
@@ -249,6 +272,21 @@ class LookupBatcher:
 
     def _serve_batch(self, reqs: List[LookupRequest]) -> None:
         srv = self.server
+        # degraded window (ISSUE 10): requests admitted BEFORE the
+        # window opened are shed here with the same distinct error the
+        # session door uses — a degraded server never dispatches a
+        # gather (no torn or stale read; the restore is mutating the
+        # pools under the lock this batch would otherwise take)
+        reason = srv._degraded_reason
+        if reason is not None:
+            for r in reqs:
+                self.queue.c_degraded.inc()
+                self.queue.c_shed.inc()
+                if r.tenant is not None:
+                    r.tenant.c_shed.inc()
+                r.fail(ServeDegradedError(
+                    f"serve degraded: {reason} — queued lookup shed"))
+            return
         fl = srv.flight
         t_dispatch = time.perf_counter()  # batch window closes, the
         # coalesced lookup starts (flight.batch -> flight.program edge)

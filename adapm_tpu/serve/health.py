@@ -100,6 +100,12 @@ class HealthMonitor:
         import time
         reasons: List[str] = []
         batcher = self.plane.batcher
+        # degraded window (ISSUE 10): a restoring server sheds every
+        # lookup with ServeDegradedError — not-ready by definition
+        degraded = getattr(self.server, "_degraded_reason", None)
+        if degraded is not None:
+            reasons.append(f"degraded: {degraded} (lookups shed with "
+                           f"ServeDegradedError)")
         if not batcher.is_alive():
             reasons.append("dispatcher thread not running")
         wedged = batcher.wedged_dispatchers(self.wedge_s)
@@ -113,6 +119,20 @@ class HealthMonitor:
         if depth >= bound:
             reasons.append(
                 f"admission queue saturated ({depth}/{bound})")
+        # executor watchdog (ISSUE 10): any stream whose CURRENT
+        # program is busy past --sys.fault.watchdog_s is wedged — a
+        # stuck sync round / tier commit / checkpoint save flips
+        # readiness the same way a stuck dispatcher does (the probe
+        # reads busy stamps, never blocking behind the wedged program)
+        exw = self.server.exec.wedged_streams(
+            self.server.opts.fault_watchdog_s,
+            exclude=batcher.streams)
+        if exw:
+            names = [w["stream"] for w in exw]
+            reasons.append(
+                f"executor stream(s) {names} wedged: busy on one "
+                f"program > {self.server.opts.fault_watchdog_s:.0f}s "
+                f"(--sys.fault.watchdog_s)")
         dead = self._dead()
         if dead:
             reasons.append(
@@ -122,6 +142,8 @@ class HealthMonitor:
                "dead_nodes": dead, "queue_depth": depth,
                "queue_bound": bound,
                "dispatchers": batcher.dispatchers,
-               "wedged_dispatchers": wedged}
+               "wedged_dispatchers": wedged,
+               "wedged_streams": [w["stream"] for w in exw],
+               "degraded": degraded}
         self._cache = (time.monotonic(), out)
         return out

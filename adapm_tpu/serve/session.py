@@ -45,7 +45,8 @@ from typing import Optional
 
 import numpy as np
 
-from .admission import DeadlineExceededError, LookupRequest
+from .admission import (DeadlineExceededError, LookupRequest,
+                        ServeDegradedError)
 
 # bounded grace for a CLAIMED request's in-flight delivery: a device
 # gather is milliseconds; a dispatcher that cannot deliver within this
@@ -83,8 +84,10 @@ class ServeSession:
         defaults to `--sys.serve.deadline_ms` (0 = no deadline).
 
         Raises `ServeOverloadError` (queue full — backpressure),
-        `DeadlineExceededError` (shed), or `RuntimeError` (plane closed
-        / dispatcher wedged). Never hangs."""
+        `DeadlineExceededError` (shed), `ServeDegradedError` (the
+        server is restoring/degraded — retry once readiness recovers),
+        or `RuntimeError` (plane closed / dispatcher wedged). Never
+        hangs."""
         keys = np.ascontiguousarray(
             np.asarray(keys, dtype=np.int64).ravel())
         srv = self.server
@@ -95,6 +98,15 @@ class ServeSession:
         # other clients inside the dispatcher
         from ..base import check_key_range
         check_key_range(keys, srv.num_keys)
+        # degraded window (ISSUE 10; Server.begin_degraded — set while
+        # a checkpoint-chain restore applies): shed at the door with
+        # the distinct error, before the request touches the queue
+        reason = srv._degraded_reason
+        if reason is not None:
+            self.plane.queue.c_degraded.inc()
+            raise ServeDegradedError(
+                f"serve degraded: {reason} — lookup shed (retry once "
+                f"readiness recovers; docs/failure_handling.md)")
         lens = srv.value_lengths[keys]
         if deadline_ms is None:
             deadline_ms = self.plane.opts.serve_deadline_ms

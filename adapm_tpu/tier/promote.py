@@ -435,6 +435,13 @@ class PromotionEngine:
         revalidation -> program enqueue (the lock-narrowing rule —
         dispatch itself is async under the gate)."""
         srv = self.server
+        if srv.fault is not None:
+            # ISSUE 10 injection point: fires BEFORE the commit takes
+            # the lock or moves any row, so a retried commit (executor
+            # policy on `tier_commit`, or _pass's own backoff retry
+            # when inline) re-runs cleanly; the wanted rows stay cold
+            # until a commit succeeds — slower, never wrong
+            srv.fault.fire("tier.promote")
         with srv._lock:
             n = ensure_hot_rows(srv, st, sh, sl, min_clock=min_clock)
         if n:

@@ -66,6 +66,11 @@ def manifest_path(path: str) -> str:
 def save_server(server, path: str) -> None:
     """Write the full manager state (single-controller: one .npz;
     multi-process: per-rank shards + manifest, globally quiesced)."""
+    if server.fault is not None:
+        # ISSUE 10 injection point (shared with the incremental chain):
+        # fires before any I/O, so a failed save leaves the previous
+        # checkpoint intact
+        server.fault.fire("ckpt.save")
     if server.glob is not None:
         # quiesce so every delta is merged and every base is fresh
         server.wait_sync()
@@ -118,6 +123,10 @@ def restore_server(server, path: str) -> None:
     Server (same num_keys, value_lengths, shard count, pool geometry;
     multi-process: same process count — each rank reads its own shard)."""
     import jax
+    if server.fault is not None:
+        # fires before any mutation: a failed restore leaves the live
+        # server serving its current state (ISSUE 10)
+        server.fault.fire("ckpt.restore")
     if server.glob is not None:
         mf = np.load(manifest_path(path))
         assert int(mf["num_procs"]) == server.num_procs, \

@@ -1060,6 +1060,66 @@ def bench_w2v(V=100_000, d=128, B=8192, N=5, steps=40, warmup=4,
     return B * scan_steps / dt
 
 
+def bench_fault(E=40_000, vlen=32, dirty_frac=0.01):
+    """Robustness phase (ISSUE 10): incremental-vs-full checkpoint
+    bytes and crash-recovery wall time. Host-CPU by design — the
+    numbers are file bytes and a restore wall time dominated by host
+    serialization, not device compute.
+
+    Shape: full base checkpoint of an E x vlen model, a
+    `dirty_frac` trickle, then a dirty-slot delta; the server is shut
+    down (the crash) and a fresh one restores the chain. The artifact
+    carries the bytes ratio (the incremental lever) and recovery_s
+    (ROADMAP item 5's recovery-time metric)."""
+    import tempfile
+
+    import adapm_tpu
+    from adapm_tpu.config import SystemOptions
+    from adapm_tpu.fault import IncrementalCheckpointer, restore_chain
+    rng = np.random.default_rng(0)
+    opts = SystemOptions(sync_max_per_sec=0, prefetch=False)
+    _progress(f"fault phase: building server ({E} keys x {vlen})")
+    srv = adapm_tpu.setup(E, vlen, opts=opts, num_workers=2)
+    w = srv.make_worker(0)
+    w.set(np.arange(E), rng.normal(size=(E, vlen)).astype(np.float32))
+    chain = tempfile.mkdtemp(prefix="adapm_bench_fault_")
+    ck = IncrementalCheckpointer(srv, chain)
+    t0 = time.perf_counter()
+    base = ck.save()
+    base_save_s = time.perf_counter() - t0
+    n_dirty = max(1, int(E * dirty_frac))
+    dirty = rng.choice(E, size=n_dirty, replace=False)
+    w.push(dirty, np.ones((n_dirty, vlen), np.float32))
+    t0 = time.perf_counter()
+    delta = ck.save()
+    delta_save_s = time.perf_counter() - t0
+    expected = np.asarray(srv.read_main(np.arange(256)))
+    _progress(f"fault phase: base {base['bytes']}B, "
+              f"{dirty_frac:.0%}-dirty delta {delta['bytes']}B; "
+              f"killing + restoring")
+    srv.shutdown()
+    srv2 = adapm_tpu.setup(E, vlen, opts=SystemOptions(
+        sync_max_per_sec=0, prefetch=False), num_workers=2)
+    recovery_s = restore_chain(srv2, chain)
+    assert np.array_equal(
+        np.asarray(srv2.read_main(np.arange(256))), expected), \
+        "post-restore sample not bit-exact"
+    out = {"keys": E, "vlen": vlen,
+           "full_bytes": base["bytes"],
+           "delta_bytes": delta["bytes"],
+           "dirty_slots": delta["slots"],
+           "incremental_ratio": round(
+               delta["bytes"] / base["bytes"], 5),
+           "base_save_s": round(base_save_s, 4),
+           "delta_save_s": round(delta_save_s, 4),
+           "recovery_s": round(recovery_s, 4),
+           "metrics": srv2.metrics_snapshot()}
+    _progress(f"fault phase: ratio {out['incremental_ratio']} "
+              f"recovery_s {out['recovery_s']}")
+    srv2.shutdown()
+    return out
+
+
 def bench_cpu_torch(E=200_000, R=1_000, d=128, B=4096, N=32,
                     steps=3) -> float:
     """Measured CPU baseline: the same ComplEx+AdaGrad batch step written
@@ -1265,6 +1325,16 @@ def _phase_exec():
     return out
 
 
+def _phase_fault():
+    import jax
+    sz = {"E": 8_000} if os.environ.get("ADAPM_BENCH_SMALL") else {}
+    out = bench_fault(**sz)
+    out["virtual_shards"] = len(jax.devices("cpu"))
+    if sz:
+        out["small_sizes"] = sz
+    return out
+
+
 def _phase_w2v():
     if os.environ.get("ADAPM_BENCH_SMALL"):
         small = dict(V=20_000, d=64, B=2048, warmup=2)
@@ -1295,15 +1365,16 @@ _PHASES = {"probe": _phase_probe, "kge": _phase_kge,
            "prefetch": _phase_prefetch, "scan": _phase_scan,
            "dedup": _phase_dedup, "pm": _phase_pm, "mgmt": _phase_mgmt,
            "compress": _phase_compress, "serve": _phase_serve,
-           "tier": _phase_tier, "exec": _phase_exec, "w2v": _phase_w2v,
+           "tier": _phase_tier, "exec": _phase_exec,
+           "fault": _phase_fault, "w2v": _phase_w2v,
            "cpu": _phase_cpu}
 
 # generous per-phase walls: a healthy phase finishes in a fraction of
 # these; a wedged relay burns one wall once, then the driver degrades
 _TIMEOUTS = {"probe": 120, "kge": 1200, "prefetch": 1200, "scan": 900,
              "dedup": 900, "pm": 900, "mgmt": 900, "compress": 900,
-             "serve": 900, "tier": 900, "exec": 900, "w2v": 900,
-             "cpu": 600}
+             "serve": 900, "tier": 900, "exec": 900, "fault": 900,
+             "w2v": 900, "cpu": 600}
 
 _CPU_ENV = {"JAX_PLATFORMS": "cpu", "ADAPM_PLATFORM": "cpu",
             "ADAPM_BENCH_SMALL": "1"}
@@ -1427,6 +1498,9 @@ def main():
     # configurations on the same backend, and the overlap being
     # measured is host prep vs device dispatch on this host
     results["exec"] = _run_phase("exec", pm_env)
+    # robustness phase (ISSUE 10): host-CPU by design — incremental
+    # checkpoint bytes and recovery wall time are host serialization
+    results["fault"] = _run_phase("fault", pm_env)
     results["cpu"] = _run_phase("cpu")
 
     def phase_val(name, field):
@@ -1512,6 +1586,8 @@ def main():
                  else {"error": "tier failed"}),
         "exec": (results["exec"] if _ok(results["exec"])
                  else {"error": "exec failed"}),
+        "fault": (results["fault"] if _ok(results["fault"])
+                  else {"error": "fault failed"}),
         "w2v_pairs_per_sec": round(w2v, 1),
         "dedup": {"unique_batch_triples_per_sec": round(tput_unique, 1),
                   "gain_vs_skewed":

@@ -89,6 +89,19 @@ def main() -> int:
     print("[overhead-check] flight tracing default-off: no tracer, "
           "zero flight.* names; probe times the hot path with the "
           "flight branch compiled in")
+    # ISSUE 10: the fault-injection plane is compiled in but DEFAULT
+    # OFF — no FaultPlane object, zero fault.* registry names, and the
+    # instrumented sites (executor dispatch, sync tick, serve drain,
+    # tier commit, checkpoint I/O) each pay one `is None` check. The
+    # unchanged median-ratio guard below times the pull/push hot path
+    # with those branches present.
+    assert srv.fault is None, \
+        "fault injection must be DEFAULT OFF (--sys.fault.spec empty)"
+    fault_names = [n for n in names if n.startswith("fault.")]
+    assert not fault_names, \
+        f"default-off fault plane registered metrics: {fault_names}"
+    print("[overhead-check] fault injection default-off: no plane, "
+          "zero fault.* names; injection points are zero-cost skips")
     saved = (w._h_pull, w._h_push, w._h_set, srv.sync._h_round)
     probe(w, batches, vals, 30)  # warm the jit caches
     # per-pair (off, on) timings back to back; the guard is the MEDIAN

@@ -46,5 +46,13 @@ python scripts/compress_drift_check.py
 # max_wait_us DOWN and land the observed serve P99 within the tolerance
 # band of the target (median of trailing measurement windows)
 python scripts/slo_convergence_check.py
+# fault drill (ISSUE 10): a seeded push/serve/promote/sync storm under
+# injected transient faults must stay bit-identical to an uninjected
+# shadow; a server killed mid-storm must restore from the incremental
+# checkpoint chain bit-exactly within the recovery bound; lookups
+# during the degraded restore window shed with ServeDegradedError
+# (never a torn or stale read); and a 1%-dirty trickle's delta link
+# must cost <= 10% of a full checkpoint
+python scripts/fault_drill_check.py
 python -m pytest tests/ -q "$@"
 echo "ALL TESTS PASSED"

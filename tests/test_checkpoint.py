@@ -1,7 +1,15 @@
 """Manager checkpoint/restore: exact state round-trip including adapted
 placement (replicas + relocations), which the reference loses on restart
-(its checkpointing is app-level only, SURVEY.md §5)."""
+(its checkpointing is app-level only, SURVEY.md §5). Plus the
+incremental chain's corruption handling (ISSUE 10 satellite): a
+truncated shard, a flipped checksum byte, and a missing manifest link
+each fail loudly with a NAMED error and leave the live server
+untouched."""
+import json
+import os
+
 import numpy as np
+import pytest
 
 import adapm_tpu
 from adapm_tpu.base import CLOCK_MAX, MgmtTechniques
@@ -114,3 +122,100 @@ def test_restore_reseeds_existing_worker_clocks(tmp_path):
     assert w0c.current_clock == 7
     assert w0c.advance_clock() == 8
     srv3.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# incremental-chain corruption (ISSUE 10 satellite): every broken-chain
+# shape fails LOUDLY with a named error BEFORE any server mutation
+# ---------------------------------------------------------------------------
+
+
+def _chain_with_live_server(tmp_path):
+    from adapm_tpu.fault import IncrementalCheckpointer
+    srv, (w0, w1) = _adapted_server()
+    path = str(tmp_path / "chain")
+    ck = IncrementalCheckpointer(srv, path)
+    ck.save()
+    w0.push(np.arange(4), np.ones((4, 4), np.float32))
+    ck.save()
+    w0.push(np.arange(8, 12), np.ones((4, 4), np.float32))
+    ck.save()
+    return srv, path
+
+
+def _assert_untouched_and_live(srv, before):
+    # verification failed before mutation: same bits, still serving
+    assert np.array_equal(
+        np.asarray(srv.read_main(np.arange(32))), before)
+    assert not srv.degraded
+    srv.quiesce()  # the live server keeps working end to end
+    assert np.isfinite(np.asarray(srv.read_main(np.arange(32)))).all()
+
+
+def test_chain_truncated_shard_fails_loudly(tmp_path):
+    from adapm_tpu.fault import CheckpointCorruptError, restore_chain
+    srv, path = _chain_with_live_server(tmp_path)
+    try:
+        before = np.asarray(srv.read_main(np.arange(32)))
+        f = os.path.join(path, "delta-000001.npz")
+        data = open(f, "rb").read()
+        with open(f, "wb") as fh:
+            fh.write(data[: len(data) // 2])  # torn write
+        with pytest.raises(CheckpointCorruptError,
+                           match="delta-000001"):
+            restore_chain(srv, path)
+        _assert_untouched_and_live(srv, before)
+    finally:
+        srv.shutdown()
+
+
+def test_chain_flipped_byte_fails_loudly(tmp_path):
+    from adapm_tpu.fault import CheckpointCorruptError, restore_chain
+    srv, path = _chain_with_live_server(tmp_path)
+    try:
+        before = np.asarray(srv.read_main(np.arange(32)))
+        f = os.path.join(path, "base-000000.npz")
+        data = bytearray(open(f, "rb").read())
+        data[len(data) // 2] ^= 0xFF  # one flipped byte
+        with open(f, "wb") as fh:
+            fh.write(bytes(data))
+        with pytest.raises(CheckpointCorruptError, match="checksum"):
+            restore_chain(srv, path)
+        _assert_untouched_and_live(srv, before)
+    finally:
+        srv.shutdown()
+
+
+def test_chain_missing_link_fails_loudly(tmp_path):
+    from adapm_tpu.fault import CheckpointChainError, restore_chain
+    srv, path = _chain_with_live_server(tmp_path)
+    try:
+        before = np.asarray(srv.read_main(np.arange(32)))
+        # a deleted middle link is a MISSING link, named
+        os.remove(os.path.join(path, "delta-000001.npz"))
+        with pytest.raises(CheckpointChainError,
+                           match="missing chain link delta-000001"):
+            restore_chain(srv, path)
+        _assert_untouched_and_live(srv, before)
+    finally:
+        srv.shutdown()
+
+
+def test_chain_spliced_manifest_fails_loudly(tmp_path):
+    """Editing the manifest (dropping a middle entry) breaks the
+    predecessor-digest chain even though every remaining file's own
+    checksum passes — a restore must never quietly skip a delta."""
+    from adapm_tpu.fault import CheckpointChainError, restore_chain
+    srv, path = _chain_with_live_server(tmp_path)
+    try:
+        before = np.asarray(srv.read_main(np.arange(32)))
+        mp = os.path.join(path, "chain.json")
+        m = json.load(open(mp))
+        del m["entries"][1]  # splice out the middle delta
+        with open(mp, "w") as fh:
+            json.dump(m, fh)
+        with pytest.raises(CheckpointChainError):
+            restore_chain(srv, path)
+        _assert_untouched_and_live(srv, before)
+    finally:
+        srv.shutdown()

@@ -297,3 +297,44 @@ def test_collective_sync_knobs():
          "--sys.collective_cadence", "8"]))
     assert on.collective_sync is True and on.collective_bucket == 256
     assert on.collective_cadence == 8
+
+
+def test_fault_and_ckpt_knobs_round_trip_and_rejection():
+    """--sys.fault.* / --sys.checkpoint.* parse into the options the
+    fault plane, executor policy, and periodic checkpointer consume
+    (ISSUE 10); bad combinations fail loudly at parse time."""
+    import argparse
+
+    import pytest
+
+    from adapm_tpu.config import SystemOptions
+    p = argparse.ArgumentParser()
+    SystemOptions.add_arguments(p)
+    dflt = SystemOptions.from_args(p.parse_args([]))
+    # defaults: NO injection plane, inert retry policy, no periodic ckpt
+    assert dflt.fault_spec == "" and dflt.fault_seed == 0
+    assert (dflt.fault_retries, dflt.fault_watchdog_s) == (3, 30.0)
+    assert dflt.ckpt_every_s == 0.0 and dflt.ckpt_path is None
+    on = SystemOptions.from_args(p.parse_args(
+        ["--sys.fault.spec", "sync.round=0.2,serve.drain=0.1",
+         "--sys.fault.seed", "7", "--sys.fault.retries", "5",
+         "--sys.fault.backoff_ms", "2", "--sys.fault.watchdog_s", "9",
+         "--sys.checkpoint.every", "30",
+         "--sys.checkpoint.path", "/tmp/chain"]))
+    assert on.fault_spec == "sync.round=0.2,serve.drain=0.1"
+    assert on.fault_seed == 7 and on.fault_retries == 5
+    assert on.fault_backoff_ms == 2.0 and on.fault_watchdog_s == 9.0
+    assert on.ckpt_every_s == 30.0 and on.ckpt_path == "/tmp/chain"
+    bad = (["--sys.fault.spec", "oops"],           # not point=prob
+           ["--sys.fault.spec", "x=1.5"],          # prob out of range
+           ["--sys.fault.retries", "-1"],
+           ["--sys.fault.watchdog_s", "0"],
+           ["--sys.checkpoint.every", "-2"],
+           # periodic checkpoints without a chain directory
+           ["--sys.checkpoint.every", "30"])
+    for argv in bad:
+        with pytest.raises(ValueError):
+            SystemOptions.from_args(p.parse_args(argv))
+    # hand-built options are validated the same way
+    with pytest.raises(ValueError):
+        SystemOptions(fault_spec="x=nan").validate_serve()

@@ -66,6 +66,54 @@ def test_launch_local_propagates_failure(tmp_path):
         2, [sys.executable, str(script)], keepalive=False) == 7
 
 
+def test_launch_local_restart_budget_stops_crash_loop(tmp_path):
+    """ISSUE 10 satellite: a rank that ALWAYS exits 254 used to be
+    restarted forever at a fixed 0.5 s cadence (the reference
+    dmlc_local.py contract). The hardened keepalive applies capped
+    exponential backoff and gives up after the restart budget,
+    propagating the 254 as the job's failure code."""
+    import time as _time
+    attempts = tmp_path / "attempts"
+    script = tmp_path / "prog.py"
+    script.write_text(textwrap.dedent(f"""
+        import sys
+        with open(r"{attempts}", "a") as f:
+            f.write("x")
+        sys.exit(254)
+    """))
+    t0 = _time.monotonic()
+    code = launcher.launch_local(
+        1, [sys.executable, str(script)], keepalive=True,
+        max_restarts=3, backoff_base_s=0.01, backoff_max_s=0.04)
+    elapsed = _time.monotonic() - t0
+    # budget exhausted: the crash loop stops and the 254 surfaces
+    assert code == launcher.KEEPALIVE_EXIT_CODE
+    # initial run + exactly max_restarts restarts, never unbounded
+    assert attempts.read_text() == "x" * 4
+    # backoff actually waited: 0.01 + 0.02 + 0.04 (capped) >= 0.07 s
+    assert elapsed >= 0.07
+
+
+def test_launch_local_keepalive_still_recovers_within_budget(tmp_path):
+    """A transiently-crashing rank (254 once, then clean) still
+    recovers under the hardened keepalive — the budget bounds crash
+    LOOPS, not legitimate restarts."""
+    marker = tmp_path / "ran_once"
+    script = tmp_path / "prog.py"
+    script.write_text(textwrap.dedent(f"""
+        import os, sys
+        m = r"{marker}"
+        if not os.path.exists(m):
+            open(m, "w").write("x")
+            sys.exit(254)
+        sys.exit(0)
+    """))
+    code = launcher.launch_local(
+        1, [sys.executable, str(script)], keepalive=True,
+        max_restarts=3, backoff_base_s=0.01)
+    assert code == 0 and marker.exists()
+
+
 def _rank_recorder(tmp_path):
     """A program that records its ADAPM_* env, used to verify the env
     contract each launch mode assembles."""
@@ -170,7 +218,7 @@ def test_launcher_main_dispatches_all_modes(tmp_path, monkeypatch):
     calls = {}
     monkeypatch.setattr(
         launcher, "launch_local",
-        lambda n, cmd, keepalive=True: calls.setdefault(
+        lambda n, cmd, keepalive=True, **kw: calls.setdefault(
             "local", (n, cmd, keepalive)) and 0 or 0)
     monkeypatch.setattr(
         launcher, "launch_ssh",

@@ -180,7 +180,7 @@ def test_tier_metrics_section_schema_v4(rng):
     w.pull_sync(np.arange(0, 64))
     srv.tier.promote_keys(np.arange(0, 16))
     snap = srv.metrics_snapshot()
-    assert snap["schema_version"] == 8
+    assert snap["schema_version"] == 9
     t = snap["tier"]
     assert t["promotions"] >= 16
     assert 0.0 <= t["hot_hit_rate"] <= 1.0
@@ -344,15 +344,22 @@ def test_two_servers_concurrent_sharded_dispatch_bounded(rng):
 # ---------------------------------------------------------------------------
 
 
-def test_shutdown_deterministic_and_double_close(rng):
+def test_shutdown_deterministic_and_double_close(rng, tmp_path):
     from adapm_tpu.serve import ServePlane
-    srv = _mk(True, hot_rows=16)
+    srv = _mk(True, hot_rows=16,
+              ckpt_every_s=0.02, ckpt_path=str(tmp_path / "chain"))
     w = srv.make_worker(0)
     w.set(np.arange(E), rng.normal(size=(E, L)).astype(np.float32))
     plane = ServePlane(srv)
     plane.session().lookup(np.arange(8))
     srv.tier.engine.kick()   # queue real tier maintenance work
     srv.start_sync_thread()
+    # race an in-flight checkpoint program against shutdown (ISSUE 10
+    # satellite): a zero-delay save is queued on the `ckpt` stream
+    # right as teardown begins; close must DRAIN it before pool
+    # teardown, never cancel it into a half-written chain or read
+    # through torn-down pools
+    srv.exec.submit("ckpt", srv.ckpt.save, label="ckpt.save.race")
     srv.shutdown()
     # every background producer is down after the first shutdown, and
     # the unified executor closed LAST with nothing left on its streams
@@ -361,7 +368,13 @@ def test_shutdown_deterministic_and_double_close(rng):
     assert srv.exec.closed
     assert srv.exec.live_streams() == [], \
         "orphaned executor streams survived shutdown"
+    # the raced save drained (not cancelled): the chain manifest
+    # describes only durably-written, checksum-valid links
+    from adapm_tpu.fault.ckpt import _load_verified_chain
+    assert len(_load_verified_chain(str(tmp_path / "chain"))) >= 1
     srv.shutdown()  # double-close must be a no-op, not a crash
+    # ... and the checkpointer's own close is idempotent too
+    srv.ckpt.close()
     # a submit against the closed executor is a cancelled no-op, not a
     # crash (late kicks during teardown)
     c = srv.exec.submit("tier", lambda: 1)

@@ -274,6 +274,18 @@ class SystemOptions:
     ckpt_every_s: float = 0.0
     ckpt_path: Optional[str] = None
 
+    # -- runtime lock-order sentinel (sys.lint.*; adapm_tpu/lint/
+    #    lockorder.py, docs/INVARIANTS.md): wrap the server lock, the
+    #    dispatch gate, and the admission/registry locks in a recorder
+    #    that raises LockOrderError on an acquisition-graph cycle or a
+    #    gate-leaf violation (any lock taken while the gate is held).
+    #    Default off — the Server then builds plain RLocks and the
+    #    gate proxy pays one `is None` check per acquire (the r7
+    #    skip-wrapper discipline). The tier-1 storm tests run with it
+    #    on, so the dynamic checker validates exactly what the static
+    #    adapm-lint rules (APM001/APM002) claim.
+    lint_lockorder: bool = False
+
     # -- sampling (--sampling.*)
     sampling_scheme: str = "local"   # naive | preloc | pool | local
     sampling_reuse_factor: int = 32  # pool scheme
@@ -530,6 +542,8 @@ class SystemOptions:
                        dest="sys_ckpt_every", type=float, default=0.0)
         g.add_argument("--sys.checkpoint.path",
                        dest="sys_ckpt_path", default=None)
+        g.add_argument("--sys.lint.lockorder",
+                       dest="sys_lint_lockorder", type=int, default=0)
         s = parser.add_argument_group("sampling")
         s.add_argument("--sampling.scheme", dest="sampling_scheme",
                        default="local",
@@ -603,6 +617,7 @@ class SystemOptions:
             fault_watchdog_s=args.sys_fault_watchdog_s,
             ckpt_every_s=args.sys_ckpt_every,
             ckpt_path=args.sys_ckpt_path,
+            lint_lockorder=bool(args.sys_lint_lockorder),
             sampling_scheme=args.sampling_scheme,
             sampling_reuse_factor=args.sampling_reuse,
             sampling_pool_size=args.sampling_pool_size,

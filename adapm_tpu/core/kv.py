@@ -252,6 +252,21 @@ class Server:
         # tests/benches must self-serialize), and wait_sync/quiesce wrap
         # it around multi-call sequences.
         self._round_lock = threading.RLock()
+        if self.opts.lint_lockorder:
+            # runtime lock-order sentinel (ISSUE 11; lint/lockorder.py,
+            # docs/INVARIANTS.md): record this server's lock
+            # acquisitions in the process-wide graph — a cycle or a
+            # lock taken under the dispatch gate raises LockOrderError
+            # at the acquire, deterministically, instead of waiting for
+            # a storm to actually deadlock. Off (the default) builds
+            # the plain RLocks above: zero wrapper anywhere hot.
+            from ..lint import lockorder
+            lockorder.enable_sentinel()
+            self._lock = lockorder.SentinelLock("server", self._lock)
+            self._round_lock = lockorder.SentinelLock(
+                "sync_round", self._round_lock)
+            self.obs._lock = lockorder.SentinelLock(
+                "metrics_registry", self.obs._lock)
         self._in_setup = False
         # worker-thread barrier state (reference ColoKVWorker::Barrier is a
         # barrier over ALL workers, threads included, via the scheduler's
@@ -1304,6 +1319,9 @@ class Server:
         # running in other threads, and blocking on a donated buffer raises
         with self._lock:
             for s in self.stores:
+                # apm-lint: disable=APM002 quiesce point BY DESIGN: the
+                # lock must be held across the device wait here, or a
+                # racing op donates the very buffer being blocked on
                 s.block()
 
     def dead_nodes(self, max_age_s: float = 10.0) -> list:
@@ -1931,11 +1949,12 @@ class Worker:
         vals = np.asarray(vals, dtype=np.float32)
         srv = self.server
         probe = None
-        if srv.flight is not None:
+        fl = srv.flight  # bind-once, test-once (APM003 skip-wrapper)
+        if fl is not None:
             # event-to-servable freshness probe (sampled): push wall
             # time -> first serve read of the key (obs/flight.py);
             # marked visible under the lock once the scatter enqueues
-            probe = srv.flight.freshness.note_push(keys)
+            probe = fl.freshness.note_push(keys)
         after = self._live_write_futs() if srv.glob is not None else ()
         plan, tv = None, -1
         if srv.opts.optimistic_routing:
@@ -1950,7 +1969,7 @@ class Worker:
                                        is_set=False, after=after,
                                        plan=plan)
             if probe is not None:
-                srv.flight.freshness.push_visible(probe)
+                fl.freshness.push_visible(probe)
         self.stats["push_ops"] += 1
         self.stats["push_params"] += len(keys)
         self.stats["push_params_local"] += len(keys) - n_remote

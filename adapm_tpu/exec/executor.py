@@ -45,21 +45,32 @@ from typing import Callable, Dict, List, Optional
 # the sharded-dispatch gate (the process-wide "collective stream")
 # ---------------------------------------------------------------------------
 
-# One RLock per process, shared by every Server/store/runner regardless
+# One gate per process, shared by every Server/store/runner regardless
 # of which MeshContext it was built on: in-process device sets always
 # share the same XLA backend (and its per-device execution queues), so
 # one gate covers every combination of servers that could interleave.
 # Reentrant: store ops nest (tiered gather -> cold-path program) and a
-# caller already holding the gate must not self-deadlock.
-_DISPATCH_GATE = threading.RLock()
+# caller already holding the gate must not self-deadlock. The RLock
+# lives inside a SentinelLock (lint/lockorder.py): dispatch sites
+# capture the gate at import (`_GATE = dispatch_gate()`), so the
+# lock-order sentinel cannot swap it per server the way it swaps
+# Server._lock — instead the wrapper pays the r7 skip-wrapper price,
+# one `is None` check per acquire when the sentinel is off
+# (--sys.lint.lockorder, default), full leaf/cycle edge recording
+# when it is on.
+from ..lint.lockorder import GATE_NAME, GATE_UID, SentinelLock
+
+_DISPATCH_GATE = SentinelLock(GATE_NAME, uid=GATE_UID)
 
 
-def dispatch_gate() -> "threading.RLock":
+def dispatch_gate() -> "SentinelLock":
     """The process-wide sharded-dispatch mutex. Every site that
     dispatches a sharded device program acquires it around the dispatch
     (enqueue) itself — `with dispatch_gate(): self.main = _prog(...)`.
     Held for the enqueue only; never across device execution, network
-    waits, or the server lock (it is a LEAF lock)."""
+    waits, or the server lock (it is a LEAF lock — mechanically
+    enforced by adapm-lint APM001/APM002 and, at runtime, by the
+    --sys.lint.lockorder sentinel; docs/INVARIANTS.md)."""
     return _DISPATCH_GATE
 
 

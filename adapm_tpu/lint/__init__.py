@@ -1,0 +1,25 @@
+"""adapm-lint (ISSUE 11): the AST invariant analyzer + runtime
+lock-order sentinel for the seven-plane concurrency contract.
+
+Two halves, one contract (docs/INVARIANTS.md):
+
+  - ``analyzer``/``rules`` — the static pass: rule IDs ``APM001``..
+    ``APM007`` over the package's own ASTs, justified
+    ``# apm-lint: disable=`` suppressions that fail CI when unused,
+    deterministic JSON + human reports. Run by
+    ``scripts/invariant_lint_check.py`` inside run_tests.sh.
+  - ``lockorder`` — the dynamic pass: an opt-in
+    (``--sys.lint.lockorder``) sentinel wrapped around the server
+    lock, the dispatch gate, and the admission/registry locks that
+    records the per-thread acquisition graph and raises on a cycle or
+    a gate-leaf violation — enabled inside the tier-1 storm tests so
+    the runtime checker validates exactly what the static rules claim.
+
+Pure stdlib on purpose: importable with no device stack.
+"""
+from .analyzer import (Analyzer, Finding, ModuleInfo,  # noqa: F401
+                       ProjectContext, Report, Rule, Suppression)
+from .lockorder import (LockOrderError, LockOrderSentinel,  # noqa: F401
+                        SentinelLock, enable_sentinel, get_sentinel,
+                        disable_sentinel)
+from .rules import default_rules  # noqa: F401

@@ -143,6 +143,9 @@ def start_heartbeat(interval_s: float = 2.0) -> None:
             if _hb_stop.wait(interval_s):
                 return
 
+    # apm-lint: disable=APM004 process-level heartbeat with no Server
+    # (hence no executor) in scope: the control plane outlives and
+    # predates any Server on this rank (launcher-adjacent, like dcn.py)
     threading.Thread(target=loop, daemon=True,
                      name="adapm-heartbeat").start()
 

@@ -62,7 +62,9 @@ class ServePlane:
         self.server = server
         self.opts = opts
         self.queue = AdmissionQueue(opts.serve_queue, registry=server.obs,
-                                    lanes=max(1, opts.serve_dispatchers))
+                                    lanes=max(1, opts.serve_dispatchers),
+                                    lockorder=getattr(
+                                        opts, "lint_lockorder", False))
         self.batcher = LookupBatcher(server, opts, self.queue, shard=shard)
         # read-only serve replica (ISSUE 9 tentpole a; serve/replica.py):
         # only with rows budgeted — unset, every lookup takes the exact

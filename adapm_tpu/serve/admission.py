@@ -261,13 +261,23 @@ class AdmissionQueue:
     `serve.rejected_total` / `serve.shed_total` counters, and the
     per-tenant `serve.tenant.<name>.*` counters."""
 
-    def __init__(self, bound: int, registry=None, lanes: int = 1):
+    def __init__(self, bound: int, registry=None, lanes: int = 1,
+                 lockorder: bool = False):
         assert bound >= 1, "admission queue bound must be >= 1"
         self.bound = int(bound)
         self.lanes = max(1, int(lanes))
         self._lanes: List["collections.deque[LookupRequest]"] = [
             collections.deque() for _ in range(self.lanes)]
-        self._cond = threading.Condition()
+        if lockorder:
+            # runtime lock-order sentinel (--sys.lint.lockorder;
+            # lint/lockorder.py): the admission condvar's lock joins
+            # the process-wide acquisition graph — off, a plain
+            # Condition, zero wrapper cost
+            from ..lint.lockorder import SentinelLock
+            self._cond = threading.Condition(
+                SentinelLock("serve_admission"))
+        else:
+            self._cond = threading.Condition()
         self._closed = False
         self._registry = registry
         self._tenants: Dict[str, TenantState] = {}

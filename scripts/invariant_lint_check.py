@@ -1,0 +1,77 @@
+"""adapm-lint CI gate (ISSUE 11; run FIRST by scripts/run_tests.sh).
+
+Runs the AST invariant analyzer (adapm_tpu/lint, docs/INVARIANTS.md)
+over the whole package and fails on
+
+  - any unsuppressed finding (APM001..APM007 — a violated concurrency
+    discipline), or
+  - any unused or malformed suppression (APM000 — a stale or
+    unjustified escape hatch).
+
+This is the cheapest guard in the harness: pure AST, no device stack,
+milliseconds — which is why it runs before even the prefetch smoke
+(the prefetch-smoke-first principle: a regression that CAN fail in
+seconds MUST fail in seconds).
+
+Escape hatch for incremental adoption (e.g. a branch that vendored a
+pre-lint subsystem): ``ADAPM_LINT_BASELINE=<path>``. If the file
+exists, findings already recorded in it are tolerated (and reported as
+"baselined", so they stay visible); if it does not, the current
+findings are written there and the run passes — commit the baseline,
+then burn it down. NEW findings always fail regardless of baseline.
+
+Exit status: 0 clean (or fully baselined), 1 otherwise.
+"""
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main() -> int:
+    from adapm_tpu.lint import Analyzer
+    rep = Analyzer(ROOT).run()
+
+    baseline_path = os.environ.get("ADAPM_LINT_BASELINE")
+    baselined = set()
+    if baseline_path:
+        if os.path.exists(baseline_path):
+            with open(baseline_path, "r", encoding="utf-8") as fh:
+                data = json.load(fh)
+            baselined = {(f["path"], f["rule"], f["message"])
+                         for f in data.get("findings", ())}
+        else:
+            with open(baseline_path, "w", encoding="utf-8") as fh:
+                fh.write(rep.to_json())
+            print(f"[lint] baseline bootstrapped at {baseline_path} "
+                  f"({len(rep.findings)} finding(s) recorded) — commit "
+                  f"it, then burn it down")
+            return 0
+
+    fresh = [f for f in rep.findings
+             if (f.path, f.rule, f.message) not in baselined]
+    tolerated = len(rep.findings) - len(fresh)
+
+    if fresh:
+        for f in sorted(fresh):
+            print(f.format())
+        print(f"[lint] FAIL: {len(fresh)} finding(s) "
+              f"({tolerated} baselined) over {rep.files_scanned} files "
+              f"— fix the violation or add a justified "
+              f"`# apm-lint: disable=` (docs/INVARIANTS.md)")
+        return 1
+
+    print(f"[lint] OK: {rep.files_scanned} files, "
+          f"{len(rep.rules)} rules, "
+          f"{len(rep.suppressions_used)} justified suppression(s) used"
+          + (f", {tolerated} baselined finding(s) tolerated"
+             if tolerated else ""))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -8,7 +8,15 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-# fast prefetch-pipeline smoke first: a staged-pull/plan-cache regression
+# adapm-lint invariant gate FIRST (ISSUE 11): the AST analyzer checks
+# the concurrency disciplines mechanically — gate coverage, the
+# lock-narrowing rule, skip-wrappers, the raw-thread ban, donation
+# lifetimes, revalidate-under-lock, metric-catalog drift — in
+# milliseconds, before anything compiles a program. Zero unsuppressed
+# findings, zero unused suppressions (docs/INVARIANTS.md;
+# ADAPM_LINT_BASELINE is the incremental-adoption escape hatch)
+python scripts/invariant_lint_check.py
+# fast prefetch-pipeline smoke next: a staged-pull/plan-cache regression
 # should fail in seconds, not after the full matrix (the pipeline is also
 # exercised by bench.py's prefetch phase under ADAPM_BENCH_SMALL=1)
 python -m pytest tests/test_prefetch.py -q

@@ -46,6 +46,18 @@ def rng():
     return np.random.default_rng(0)
 
 
+@pytest.fixture(autouse=True)
+def _drop_lockorder_sentinel():
+    """The lock-order sentinel (lint/lockorder.py) is process-global:
+    any test that builds a --sys.lint.lockorder server installs it.
+    Tear it down after EVERY test so a sentinel enabled (or a storm
+    that failed before its own disable call) never leaks acquisition
+    edges into unrelated tests."""
+    yield
+    from adapm_tpu.lint import lockorder
+    lockorder.disable_sentinel()
+
+
 # ---------------------------------------------------------------------------
 # Isolate-and-retry for this image's known intermittent XLA-CPU abort
 # (CHANGES.md r6 note): test_checkpoint.py::test_roundtrip_exact

@@ -1,0 +1,18 @@
+"""APM001 fixture (bad): sharded program dispatched outside the gate."""
+from functools import partial
+
+import jax
+
+from adapm_tpu.exec import dispatch_gate
+
+_GATE = dispatch_gate()
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _write_main_rows(main, sh, row, vals):
+    return main.at[sh, row].set(vals, mode="drop")
+
+
+def promote(store, sh, row, vals):
+    store.main = _write_main_rows(store.main, sh, row, vals)  # BAD: no gate
+    return store.main

@@ -1,0 +1,30 @@
+"""APM001 fixture (good): every dispatch under the gate (bare and
+combined with-items, plus the dispatch_gate() call form)."""
+from functools import partial
+
+import jax
+
+from adapm_tpu.exec import dispatch_gate
+
+_GATE = dispatch_gate()
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _write_main_rows(main, sh, row, vals):
+    return main.at[sh, row].set(vals, mode="drop")
+
+
+def promote(store, sh, row, vals):
+    with _GATE:
+        store.main = _write_main_rows(store.main, sh, row, vals)
+    return store.main
+
+
+def promote_tracked(store, srv, sh, row, vals):
+    with srv.exec.track("tier"), _GATE:
+        store.main = _write_main_rows(store.main, sh, row, vals)
+
+
+def promote_call_form(store, sh, row, vals):
+    with dispatch_gate():
+        store.main = _write_main_rows(store.main, sh, row, vals)

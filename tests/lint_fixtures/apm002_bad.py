@@ -1,0 +1,17 @@
+"""APM002 fixture (bad): blocking calls under a `with *._lock:`."""
+import time
+
+
+def flush(self, completion):
+    with self._lock:
+        completion.result(timeout=30)  # BAD: wait under the lock
+
+
+def throttle(self):
+    with self._lock:
+        time.sleep(0.01)  # BAD: sleep under the lock
+
+
+def quiesce(self, srv):
+    with srv._lock:
+        srv.exec.drain("sync", timeout=5)  # BAD: drain under the lock

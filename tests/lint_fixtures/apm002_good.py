@@ -1,0 +1,14 @@
+"""APM002 fixture (good): enqueue under the lock, wait outside — plus
+the condvar exemption (a condvar wait RELEASES its lock)."""
+
+
+def flush(self, make_program):
+    with self._lock:
+        completion = make_program()  # enqueue only
+    completion.result(timeout=30)    # wait with the lock released
+
+
+def park(self):
+    with self._lock:
+        while not self._work:
+            self._cond.wait(0.5)     # condvar: releases the lock

@@ -1,0 +1,14 @@
+"""APM003 fixture (bad): unguarded optional-handle use + import-time
+metric registration."""
+from adapm_tpu.obs.metrics import MetricsRegistry
+
+registry = MetricsRegistry()
+_C = registry.counter("fixture.imported")  # BAD: import-time name
+
+
+def record(self, srv, keys):
+    srv.flight.freshness.note_push(keys)  # BAD: no `is None` guard
+
+
+def fire(self, srv):
+    srv.fault.fire("fixture.point")  # BAD: no `is None` guard

@@ -1,0 +1,30 @@
+"""APM003 fixture (good): every sanctioned guard shape — bind-to-local,
+enclosing `if`, early return, getattr probe — and construction-time
+registration."""
+
+
+class Plane:
+    def __init__(self, registry):
+        self.c_ops = registry.counter("fixture.ops")  # runtime: fine
+
+
+def record_local_bind(self, srv, keys):
+    fl = srv.flight
+    if fl is not None:
+        fl.freshness.note_push(keys)
+
+
+def record_enclosing_if(self, srv, keys):
+    if srv.flight is not None:
+        srv.flight.freshness.note_push(keys)
+
+
+def record_early_return(self, srv, keys):
+    if srv.flight is None:
+        return
+    srv.flight.freshness.note_push(keys)
+
+
+def count(self, server, n):
+    if n and getattr(server, "tier", None) is not None:
+        server.tier.c_demotions.inc(n)

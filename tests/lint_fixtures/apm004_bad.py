@@ -1,0 +1,8 @@
+"""APM004 fixture (bad): raw thread outside the allowlist."""
+import threading
+
+
+def start_worker(fn):
+    t = threading.Thread(target=fn, daemon=True)  # BAD: not allowlisted
+    t.start()
+    return t

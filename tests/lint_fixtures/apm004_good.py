@@ -1,0 +1,6 @@
+"""APM004 fixture (good): background work rides the executor."""
+
+
+def start_worker(server, fn):
+    return server.exec.submit("fixture", fn, label="fixture.pass",
+                              coalesce_key="fixture.pass")

@@ -1,0 +1,14 @@
+"""APM005 fixture (bad): donated local read after the dispatch."""
+from functools import partial
+
+import jax
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _scatter(pool, idx, vals):
+    return pool.at[idx].add(vals)
+
+
+def push(pool, idx, vals):
+    out = _scatter(pool, idx, vals)
+    return pool.sum() + out.sum()  # BAD: `pool` was donated above

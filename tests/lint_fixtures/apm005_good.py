@@ -1,0 +1,29 @@
+"""APM005 fixture (good): the result replaces the donated buffer —
+rebinding (`pool = _scatter(pool, ...)`) and attribute-held pools are
+both fine."""
+from functools import partial
+
+import jax
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _scatter(pool, idx, vals):
+    return pool.at[idx].add(vals)
+
+
+def push(pool, idx, vals):
+    pool = _scatter(pool, idx, vals)  # rebind: donation consumed it
+    return pool.sum()
+
+
+def push_attr(store, idx, vals):
+    store.main = _scatter(store.main, idx, vals)
+    return store.main.sum()
+
+
+def push_multiline(pool, idx, vals):
+    # the donated Name sits on a CONTINUATION line of its own call: its
+    # argument load must not read as "after the dispatch"
+    pool = _scatter(
+        pool, idx, vals)
+    return pool.sum()

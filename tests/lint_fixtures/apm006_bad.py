@@ -1,0 +1,10 @@
+"""APM006 fixture (bad): optimistic topology snapshot, enqueue under
+the lock, no under-lock re-read."""
+
+
+def pull(self, srv, keys):
+    tv = srv.topology_version          # snapshot OUTSIDE the lock
+    plan = self.plan_cache.get(keys, tv)
+    with srv._lock:
+        groups = srv._pull(keys, self.shard, plan=plan)  # BAD: stale?
+    return groups

@@ -1,0 +1,18 @@
+"""APM006 fixture (good): the under-lock revalidation (r6 staged-pull
+discipline), and the no-optimism path that never snapshots outside."""
+
+
+def pull(self, srv, keys):
+    tv = srv.topology_version
+    plan = self.plan_cache.get(keys, tv)
+    with srv._lock:
+        if plan is not None and srv.topology_version != tv:
+            plan = None  # topology moved underneath us: re-plan
+        groups = srv._pull(keys, self.shard, plan=plan)
+    return groups
+
+
+def pull_locked(self, srv, keys):
+    with srv._lock:
+        groups = srv._pull(keys, self.shard)
+    return groups

@@ -1,0 +1,11 @@
+"""APM007 fixture (bad): a registered metric missing from the catalog,
+and (paired with apm007_catalog.md) a catalog row with no
+registration."""
+
+
+class Plane:
+    def __init__(self, registry):
+        # NOT in apm007_catalog.md -> code->doc drift
+        self.c_rogue = registry.counter("kv.rogue_total")
+        # section `nowhere` absent from the schema block -> drift
+        self.g_lost = registry.gauge("nowhere.lost")

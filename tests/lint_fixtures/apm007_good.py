@@ -1,0 +1,12 @@
+"""APM007 fixture (good): registrations agreeing with
+apm007_catalog.md — literal, CounterGroup expansion, and a dynamic
+per-instance suffix covered by the catalog's pattern row."""
+from adapm_tpu.obs.metrics import CounterGroup
+
+
+class Plane:
+    def __init__(self, registry, lanes):
+        self.h_pull = registry.histogram("kv.pull_s")
+        self.stats = CounterGroup(registry, "kv", ("hits", "misses"))
+        for i in range(lanes):
+            registry.gauge(f"kv.lane_depth.{i}")

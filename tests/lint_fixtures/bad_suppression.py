@@ -1,0 +1,7 @@
+"""Malformed-suppression fixture: no justification text — APM000 (the
+reason is the point of the escape hatch)."""
+import threading
+
+
+def start_worker(fn):
+    return threading.Thread(target=fn)  # apm-lint: disable=APM004

@@ -1,0 +1,14 @@
+"""Suppression round-trip fixture: one APM004 violation carrying a
+justified suppression (trailing form) and one carrying the
+comment-block-above form — both must report clean and count as USED."""
+import threading
+
+
+def start_watchdog(fn):
+    return threading.Thread(target=fn)  # apm-lint: disable=APM004 fixture watchdog must outlive the pool
+
+
+def start_reporter(fn):
+    # apm-lint: disable=APM004 fixture reporter thread predates the
+    # executor and is import-gated (multi-line justification form)
+    return threading.Thread(target=fn)

@@ -1,0 +1,8 @@
+"""Unused-suppression fixture: the comment names a rule that no longer
+fires here — the run must FAIL with APM000 (stale suppressions are
+deleted, not kept)."""
+
+
+def quiet():
+    # apm-lint: disable=APM004 the thread this once justified is gone
+    return None

@@ -338,3 +338,28 @@ def test_fault_and_ckpt_knobs_round_trip_and_rejection():
     # hand-built options are validated the same way
     with pytest.raises(ValueError):
         SystemOptions(fault_spec="x=nan").validate_serve()
+
+
+def test_lint_lockorder_knob_round_trip_and_rejection():
+    """--sys.lint.lockorder (ISSUE 11): parses into the option the
+    Server's lock wiring consumes, defaults OFF (the skip-wrapper
+    shape — plain RLocks, no sentinel — is pinned by
+    tests/test_lint.py::test_lockorder_skip_wrapper_shape), and a
+    non-integer value is rejected at the parser."""
+    import argparse
+
+    import pytest
+
+    from adapm_tpu.config import SystemOptions
+    p = argparse.ArgumentParser()
+    SystemOptions.add_arguments(p)
+    dflt = SystemOptions.from_args(p.parse_args([]))
+    assert dflt.lint_lockorder is False
+    on = SystemOptions.from_args(p.parse_args(
+        ["--sys.lint.lockorder", "1"]))
+    assert on.lint_lockorder is True
+    off = SystemOptions.from_args(p.parse_args(
+        ["--sys.lint.lockorder", "0"]))
+    assert off.lint_lockorder is False
+    with pytest.raises(SystemExit):  # argparse type=int rejection
+        p.parse_args(["--sys.lint.lockorder", "maybe"])

@@ -272,6 +272,12 @@ def _mk_server(single_stream: bool):
                          # value-visible part) exactly 1 per pump on
                          # both servers
                          tier=True, tier_hot_rows=16,
+                         # runtime lock-order sentinel (ISSUE 11): the
+                         # five-producer storm is exactly the
+                         # interleaving the acquisition-graph checker
+                         # exists for — a cycle or gate-leaf violation
+                         # fails here deterministically
+                         lint_lockorder=True,
                          exec_single_stream=single_stream)
     return adapm_tpu.setup(E, L, opts=opts)
 
@@ -360,3 +366,12 @@ def test_enqueue_order_property_five_producers(rng):
     srv.shutdown()
     ref.shutdown()
     assert srv.exec.live_streams() == [] and ref.exec.live_streams() == []
+    # lock-order sentinel (ISSUE 11): the storm must have recorded a
+    # non-trivial acquisition graph and ZERO ordering violations — the
+    # dynamic validation of the APM001/APM002 static claims
+    from adapm_tpu.lint import lockorder
+    sen = lockorder.get_sentinel()
+    assert sen is not None and sen.edges(), \
+        "sentinel saw no lock edges: the storm exercised nothing"
+    sen.assert_clean()
+    lockorder.disable_sentinel()

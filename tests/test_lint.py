@@ -1,0 +1,329 @@
+"""adapm-lint (ISSUE 11): engine + rule + sentinel tests.
+
+Three layers:
+
+  1. the fixture corpus (tests/lint_fixtures/): one known-bad and one
+     known-good file per rule — every rule must FIRE on its bad
+     fixture and stay quiet on its good one (rules run in isolation so
+     a fixture for rule X never trips on rule Y's noise);
+  2. the engine: suppression round-trip (trailing and comment-block
+     forms), unused-suppression failure, malformed-suppression
+     failure, byte-identical JSON determinism;
+  3. the real tree: the package lints clean (the same check
+     scripts/invariant_lint_check.py runs in run_tests.sh), the
+     intentional-exception suppressions are USED, and the fixes this
+     PR landed stay fixed (rule IDs in the test names, per the ISSUE);
+     plus the runtime lock-order sentinel's unit behavior (cycle,
+     gate-leaf, reentrancy, condvar release, skip-wrapper shape).
+"""
+import glob
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import adapm_tpu
+from adapm_tpu.config import SystemOptions
+from adapm_tpu.lint import Analyzer, default_rules, lockorder
+from adapm_tpu.lint.rules import (DonationAfterDispatchRule,
+                                  GateCoverageRule, MetricCatalogRule,
+                                  NoBlockingUnderLockRule,
+                                  RawThreadBanRule,
+                                  RevalidateBeforeEnqueueRule,
+                                  SkipWrapperRule)
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(ROOT, "tests", "lint_fixtures")
+FIXTURE_CATALOG = os.path.join(FIXTURES, "apm007_catalog.md")
+
+_RULE_BY_ID = {
+    "APM001": GateCoverageRule,
+    "APM002": NoBlockingUnderLockRule,
+    "APM003": SkipWrapperRule,
+    "APM004": RawThreadBanRule,
+    "APM005": DonationAfterDispatchRule,
+    "APM006": RevalidateBeforeEnqueueRule,
+    "APM007": MetricCatalogRule,
+}
+
+
+def _analyze(paths, rules=None, docs=None):
+    return Analyzer(ROOT, rules=rules, paths=paths,
+                    docs=docs if docs is not None else {}).run()
+
+
+# ---------------------------------------------------------------------------
+# 1. fixture corpus: every rule fires on bad, stays quiet on good
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("rule_id", sorted(_RULE_BY_ID))
+def test_rule_fires_on_bad_fixture(rule_id):
+    bad = os.path.join(FIXTURES, f"{rule_id.lower()}_bad.py")
+    docs = {"observability": FIXTURE_CATALOG} if rule_id == "APM007" \
+        else {}
+    rep = _analyze([bad], rules=[_RULE_BY_ID[rule_id]()], docs=docs)
+    fired = [f for f in rep.findings if f.rule == rule_id]
+    assert fired, f"{rule_id} did not fire on its known-bad fixture"
+    assert all(f.path.endswith(f"{rule_id.lower()}_bad.py")
+               or f.path.endswith(".md") for f in fired)
+
+
+@pytest.mark.parametrize("rule_id", sorted(_RULE_BY_ID))
+def test_rule_quiet_on_good_fixture(rule_id):
+    good = os.path.join(FIXTURES, f"{rule_id.lower()}_good.py")
+    docs = {"observability": FIXTURE_CATALOG} if rule_id == "APM007" \
+        else {}
+    rep = _analyze([good], rules=[_RULE_BY_ID[rule_id]()], docs=docs)
+    # APM007's fixture catalog intentionally carries one doc->code
+    # drift row (`kv.ghost_total`) proving that direction — findings
+    # anchored in the GOOD .py file are what must be zero
+    code_findings = [f for f in rep.findings
+                     if f.path.endswith("_good.py")]
+    assert not code_findings, \
+        f"{rule_id} false-positived on its known-good fixture: " \
+        f"{[f.format() for f in code_findings]}"
+
+
+def test_apm007_doc_to_code_direction_fires():
+    """The fixture catalog's `kv.ghost_total` row has no registration
+    anywhere — the rule must flag the DOC side too."""
+    good = os.path.join(FIXTURES, "apm007_good.py")
+    rep = _analyze([good], rules=[MetricCatalogRule()],
+                   docs={"observability": FIXTURE_CATALOG})
+    doc_findings = [f for f in rep.findings if f.path.endswith(".md")]
+    assert any("kv.ghost_total" in f.message for f in doc_findings)
+    # the derived-kind row is exempt by design
+    assert not any("local_answer_frac" in f.message
+                   for f in rep.findings)
+
+
+# ---------------------------------------------------------------------------
+# 2. engine: suppressions + determinism
+# ---------------------------------------------------------------------------
+
+
+def test_suppression_round_trip_both_forms():
+    """Both violations in suppressed.py carry justified suppressions
+    (trailing-comment and comment-block-above forms): zero findings,
+    both suppressions counted USED."""
+    rep = _analyze([os.path.join(FIXTURES, "suppressed.py")])
+    assert not rep.findings, [f.format() for f in rep.findings]
+    assert len(rep.suppressions_used) == 2
+    assert all(s.justification for s in rep.suppressions_used)
+
+
+def test_unused_suppression_fails():
+    rep = _analyze([os.path.join(FIXTURES, "unused_suppression.py")])
+    assert [f.rule for f in rep.findings] == ["APM000"]
+    assert "unused suppression" in rep.findings[0].message
+
+
+def test_suppression_without_justification_fails():
+    """A bare `disable=APM004` is APM000 AND does not suppress — the
+    underlying APM004 still reports."""
+    rep = _analyze([os.path.join(FIXTURES, "bad_suppression.py")])
+    rules = sorted(f.rule for f in rep.findings)
+    assert rules == ["APM000", "APM004"]
+
+
+def test_suppression_in_string_literal_is_inert():
+    """Suppressions are COMMENT tokens: a suppression-shaped string
+    (doc example, the analyzer's own regex) neither suppresses nor
+    counts as unused — the analyzer lints its own source clean."""
+    path = os.path.join(ROOT, "adapm_tpu", "lint", "analyzer.py")
+    rep = _analyze([path])
+    assert not [f for f in rep.findings if f.rule == "APM000"], \
+        [f.format() for f in rep.findings]
+
+
+def test_json_report_deterministic():
+    """Same tree -> byte-identical JSON (no timestamps, sorted
+    findings/keys, repo-relative posix paths)."""
+    paths = sorted(glob.glob(os.path.join(FIXTURES, "apm00*_bad.py")))
+    docs = {"observability": FIXTURE_CATALOG}
+    a = Analyzer(ROOT, paths=paths, docs=docs).run().to_json()
+    b = Analyzer(ROOT, paths=paths, docs=docs).run().to_json()
+    assert a == b
+    assert isinstance(a, str) and a.encode() == b.encode()
+    assert "\\\\" not in a, "paths must be posix, not os-native"
+
+
+# ---------------------------------------------------------------------------
+# 3. the real tree: clean, suppressions used, fixes stay fixed
+# ---------------------------------------------------------------------------
+
+
+def _run_tree():
+    return Analyzer(ROOT).run()
+
+
+def test_package_lints_clean():
+    """The check run_tests.sh enforces: zero unsuppressed findings and
+    zero unused suppressions over adapm_tpu/."""
+    rep = _run_tree()
+    assert rep.ok(), "\n" + rep.to_text()
+    assert len(rep.rules) >= 7
+
+
+def test_apm002_server_block_suppression_used():
+    """Server.block() holds the lock across the device wait BY DESIGN
+    (a racing op would donate the buffer being blocked on) — the
+    justified suppression must exist and be exercised."""
+    rep = _run_tree()
+    assert any(s.path == "adapm_tpu/core/kv.py" and "APM002" in s.rules
+               for s in rep.suppressions_used)
+
+
+def test_apm003_push_op_binds_flight_handle():
+    """The r7 skip-wrapper fix this PR landed: Worker._push_op binds
+    `fl = srv.flight` once and reuses the local — no unguarded call
+    through the optional handle survives in core/kv.py."""
+    path = os.path.join(ROOT, "adapm_tpu", "core", "kv.py")
+    rep = _analyze([path], rules=[SkipWrapperRule()])
+    assert not [f for f in rep.findings if f.rule == "APM003"], \
+        [f.format() for f in rep.findings]
+
+
+def test_apm004_parallel_thread_suppressions_used():
+    """The two intentional raw threads (collective watchdog, control
+    heartbeat) are suppressed WITH justification, not allowlisted —
+    and both suppressions fire."""
+    rep = _run_tree()
+    used = {s.path for s in rep.suppressions_used
+            if "APM004" in s.rules}
+    assert "adapm_tpu/parallel/collective.py" in used
+    assert "adapm_tpu/parallel/control.py" in used
+
+
+def test_apm007_catalog_in_sync():
+    """The metric catalog drift this PR fixed (tier.* rows,
+    fault.loop_retries_total) stays fixed: zero APM007 findings over
+    the real tree + real docs/OBSERVABILITY.md."""
+    rep = _run_tree()
+    assert not [f for f in rep.findings if f.rule == "APM007"], \
+        "\n" + rep.to_text()
+
+
+# ---------------------------------------------------------------------------
+# runtime lock-order sentinel (lint/lockorder.py)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def sentinel():
+    lockorder.disable_sentinel()
+    sen = lockorder.enable_sentinel()
+    yield sen
+    lockorder.disable_sentinel()
+
+
+def test_lockorder_cycle_detected(sentinel):
+    a = lockorder.SentinelLock("lock_a")
+    b = lockorder.SentinelLock("lock_b")
+    with a:
+        with b:
+            pass  # records a -> b
+    with b:
+        with pytest.raises(lockorder.LockOrderError, match="cycle"):
+            a.acquire()  # b -> a inverts the recorded order
+    assert sentinel.violations == 1
+
+
+def test_lockorder_gate_is_leaf(sentinel):
+    from adapm_tpu.exec import dispatch_gate
+    other = lockorder.SentinelLock("server")
+    # server -> gate is the sanctioned order (enqueue under the lock)
+    with other:
+        with dispatch_gate():
+            pass
+    # gate -> anything is a held-across-dispatch edge: raises
+    with dispatch_gate():
+        with pytest.raises(lockorder.LockOrderError, match="LEAF"):
+            other.acquire()
+    assert sentinel.violations == 1
+
+
+def test_lockorder_gate_leaf_survives_reentrant_hold_above(sentinel):
+    """A reentrant re-acquire ABOVE the gate (server -> gate -> server
+    again) must not mask the leaf contract for the next new lock —
+    the check scans the whole held stack, not just its top."""
+    from adapm_tpu.exec import dispatch_gate
+    server = lockorder.SentinelLock("server")
+    reg = lockorder.SentinelLock("metrics_registry")
+    with server:
+        with dispatch_gate():
+            with server:  # reentrant: pushes 'server' above the gate
+                with pytest.raises(lockorder.LockOrderError,
+                                   match="LEAF"):
+                    reg.acquire()
+    assert sentinel.violations == 1
+
+
+def test_lockorder_same_name_distinct_locks_not_conflated(sentinel):
+    """Two servers' locks share the display name 'server' but are
+    DISTINCT lock objects: nesting A under B is an orderable edge (not
+    reentrancy), and the inversion is detected — the multi-server
+    storm configuration."""
+    a = lockorder.SentinelLock("server")
+    b = lockorder.SentinelLock("server")
+    with a:
+        with b:  # records A -> B (identity-keyed, same display name)
+            pass
+    with b:
+        with pytest.raises(lockorder.LockOrderError, match="cycle"):
+            a.acquire()
+    assert sentinel.violations == 1
+
+
+def test_lockorder_reentrant_and_condvar(sentinel):
+    lk = lockorder.SentinelLock("reentrant")
+    with lk:
+        with lk:  # RLock reentrancy: no new edge, no violation
+            pass
+    # condvar wait RELEASES the hold in the sentinel's view: another
+    # lock acquired by the waker while the waiter parks is no edge
+    cv = threading.Condition(lockorder.SentinelLock("cv"))
+    hit = []
+
+    def waker():
+        with cv:
+            hit.append(1)
+            cv.notify()
+
+    with cv:
+        t = threading.Thread(target=waker)
+        t.start()
+        cv.wait(timeout=5)
+    t.join(5)
+    assert hit == [1]
+    sentinel.assert_clean()
+
+
+def test_lockorder_skip_wrapper_shape():
+    """--sys.lint.lockorder off (default): Server builds PLAIN RLocks
+    (zero wrapper on the hot path); on: SentinelLock wrappers + the
+    process sentinel installed — the r7 skip-wrapper contract applied
+    to this plane."""
+    lockorder.disable_sentinel()
+    srv = adapm_tpu.setup(16, 4, opts=SystemOptions(sync_max_per_sec=0))
+    try:
+        assert not isinstance(srv._lock, lockorder.SentinelLock)
+        assert lockorder.get_sentinel() is None
+    finally:
+        srv.shutdown()
+    srv = adapm_tpu.setup(16, 4, opts=SystemOptions(
+        sync_max_per_sec=0, lint_lockorder=True))
+    try:
+        assert isinstance(srv._lock, lockorder.SentinelLock)
+        sen = lockorder.get_sentinel()
+        assert sen is not None
+        w = srv.make_worker(0)
+        w.set(np.arange(16), np.ones((16, 4), np.float32))
+        w.pull_sync(np.arange(4))
+        assert ("server", "dispatch_gate") in sen.edges()
+        sen.assert_clean()
+    finally:
+        srv.shutdown()
+        lockorder.disable_sentinel()

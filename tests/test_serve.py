@@ -190,7 +190,10 @@ def test_serve_storm_bit_identical(ctx):
     (the pull and the lookup route from the same shard as the serving
     plane, which is the consistency contract; docs/SERVING.md)."""
     s = make_server(ctx, opts=SystemOptions(sync_max_per_sec=0,
-                                            cache_slots_per_shard=64))
+                                            cache_slots_per_shard=64,
+                                            # lock-order sentinel rides
+                                            # the storm (ISSUE 11)
+                                            lint_lockorder=True))
     w0 = s.make_worker(0)   # shard 0 — the serve plane's shard
     w1 = s.make_worker(1)   # shard 1 — a second writer + replica holder
     _seed(w0)
@@ -227,6 +230,14 @@ def test_serve_storm_bit_identical(ctx):
     assert s.obs.find("serve.lookups_total").value == 50
     plane.close()
     s.shutdown()
+    # lock-order sentinel: the serve/admission locks joined the graph
+    # and nothing cycled (dynamic half of APM001/APM002; ISSUE 11)
+    from adapm_tpu.lint import lockorder
+    sen = lockorder.get_sentinel()
+    assert sen is not None and sen.edges(), \
+        "sentinel saw no lock edges: the storm exercised nothing"
+    sen.assert_clean()
+    lockorder.disable_sentinel()
 
 
 def test_serve_concurrent_storm_no_hang(ctx):

@@ -55,7 +55,10 @@ def _assert_bitwise(srv, ref, tag):
 
 
 def test_tier_storm_bit_identical_to_untiered_shadow(rng):
-    srv = _mk(True, hot_rows=16)
+    # runtime lock-order sentinel (ISSUE 11): the promote/demote/sync/
+    # relocate churn takes server lock + gate + registry in every
+    # combination this plane knows — a cycle raises here, named
+    srv = _mk(True, hot_rows=16, lint_lockorder=True)
     ref = _mk(False)
     w, wr = srv.make_worker(0), ref.make_worker(0)
     vals = rng.normal(size=(E, L)).astype(np.float32)
@@ -109,6 +112,14 @@ def test_tier_storm_bit_identical_to_untiered_shadow(rng):
     _assert_bitwise(srv, ref, "after quiesce")
     srv.shutdown()
     ref.shutdown()
+    # lock-order sentinel: non-vacuous graph, zero violations (the
+    # dynamic half of the APM001/APM002 static claims; ISSUE 11)
+    from adapm_tpu.lint import lockorder
+    sen = lockorder.get_sentinel()
+    assert sen is not None and sen.edges(), \
+        "sentinel saw no lock edges: the storm exercised nothing"
+    sen.assert_clean()
+    lockorder.disable_sentinel()
 
 
 # ---------------------------------------------------------------------------

@@ -155,6 +155,14 @@ class SystemOptions:
     # escape hatch.
     exec_single_stream: bool = False
 
+    # -- episodic execution (sys.episode.*; adapm_tpu/device/episode.py,
+    #    ISSUE 14): default step-batches per episode for EpisodicRunner
+    #    — the window whose union working set is pinned device-hot as a
+    #    unit while the next window's samples/gathers/wire rows stage on
+    #    the `episode` stream. Larger episodes amortize prep over more
+    #    steps but need hot capacity for two windows to overlap fully.
+    episode_batches: int = 8
+
     # -- store geometry
     cache_slots_per_shard: int = 0   # 0 = auto (num_keys // num_shards)
     remote_bucket_min: int = 8       # min padded size of the remote op bucket
@@ -360,6 +368,11 @@ class SystemOptions:
             raise ValueError(
                 f"--sys.tier.demote_batch must be >= 1 "
                 f"(got {self.tier_demote_batch})")
+        if self.episode_batches < 1:
+            raise ValueError(
+                f"--sys.episode.batches must be >= 1 "
+                f"(got {self.episode_batches}): an episode must hold "
+                f"at least one step batch")
         if self.exec_workers < 1:
             raise ValueError(
                 f"--sys.exec.workers must be >= 1 "
@@ -485,6 +498,8 @@ class SystemOptions:
         g.add_argument("--sys.exec.single_stream",
                        dest="sys_exec_single_stream", type=int,
                        default=0)
+        g.add_argument("--sys.episode.batches",
+                       dest="sys_episode_batches", type=int, default=8)
         g.add_argument("--sys.stats.out", dest="sys_stats_out", default=None)
         g.add_argument("--sys.trace.keys", dest="sys_trace_keys", default=None)
         g.add_argument("--sys.stats.locality", dest="sys_stats_locality",
@@ -590,6 +605,7 @@ class SystemOptions:
             tier_demote_batch=args.sys_tier_demote_batch,
             exec_workers=args.sys_exec_workers,
             exec_single_stream=bool(args.sys_exec_single_stream),
+            episode_batches=args.sys_episode_batches,
             stats_out=args.sys_stats_out,
             trace_keys=args.sys_trace_keys,
             locality_stats=args.sys_stats_locality,

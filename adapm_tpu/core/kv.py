@@ -223,6 +223,17 @@ class Server:
                                if self.opts.tier else 0),
                 tier_cold_dtype=(self.opts.tier_cold_dtype
                                  if self.opts.tier else "fp32")))
+        # device-plane accounting (ISSUE 14; schema v10): the stores
+        # share one process-wide DevicePort — surface its program /
+        # wire-ingest counters. shared=True: several servers in one
+        # process read the same port.
+        if self.obs.enabled and self.stores:
+            _port = self.stores[0].port
+            self.obs.gauge("device.programs_total", shared=True,
+                           fn=lambda p=_port: p.programs)
+            self.obs.gauge("device.wire_ingest_rows_total", shared=True,
+                           fn=lambda p=_port: p.wire_ingest_rows)
+
         self.ab = Addressbook(
             key_class, self.ctx.num_shards,
             [s.main_slots for s in self.stores],
@@ -1497,7 +1508,7 @@ class Server:
     _SNAPSHOT_SECTIONS = ("kv", "prefetch", "plan_cache", "staging",
                           "sync", "pm", "collective", "fused", "spans",
                           "serve", "tier", "exec", "flight", "slo",
-                          "fault", "ckpt")
+                          "fault", "ckpt", "device", "episode")
 
     def metrics_snapshot(self, drain_device: bool = True) -> Dict:
         """One structured, JSON-serializable telemetry dict for this
@@ -1587,8 +1598,16 @@ class Server:
         restore_chain ran on this server — `recovery_s`; `{}` unless a
         periodic checkpointer is attached or a restore ran. The
         readiness dict gains `degraded` (the restore-window shed
-        reason, None when healthy) and `wedged_streams`."""
-        out: Dict = {"schema_version": 9,
+        reason, None when healthy) and `wedged_streams`.
+
+        schema_version 10 (PR 12): always-present `device` and
+        `episode` sections (ISSUE 14). `device` — the DevicePort's
+        accounting: backend name, dispatched-program and quantized
+        wire-ingest-row totals (adapm_tpu/device). `episode` —
+        episodic-execution counters and prep/commit wall histograms
+        (device/episode.py EpisodicRunner); `{}` until a runner is
+        constructed."""
+        out: Dict = {"schema_version": 10,
                      "metrics_enabled": bool(self.obs.enabled)}
         for s in self._SNAPSHOT_SECTIONS:
             out[s] = {}
@@ -1636,6 +1655,10 @@ class Server:
         # executor occupancy/overlap summary rides with the registry's
         # exec.* gauges (same numbers, one locked read)
         out["exec"].update(self.exec.stats())
+        if self.stores:
+            # device-plane accounting (ISSUE 14): the port's own stats
+            # dict (incl. the backend name the gauges cannot carry)
+            out["device"].update(self.stores[0].port.stats())
         if self.flight is not None:
             out["flight"].update(self.flight.stats())
         if self.flight_recorder is not None:

@@ -20,37 +20,27 @@ from keys to (shard, slot) indices lives in Server/Addressbook. All programs
 take fixed-shape index buffers; batches are padded to power-of-two buckets and
 padding entries carry out-of-range indices so JAX's mode="drop" (scatter) and
 mode="fill" (gather) make them no-ops.
+
+Since ISSUE 14 the store holds NO device programs of its own: every
+dispatch goes through the narrow DevicePort (adapm_tpu/device — the
+jitted programs moved verbatim into device/jaxport.py), so a
+real-accelerator backend is one new port implementation rather than a
+store rewrite. The port brackets each enqueue in the process-wide
+sharded-dispatch gate internally (docs/EXECUTOR.md); this module is
+device-API-free (adapm-lint APM008).
 """
 from __future__ import annotations
 
 import math
-from functools import partial
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 
-from ..exec import dispatch_gate
+from ..device import default_port
+from ..device.jaxport import F16_MAX, OOB  # noqa: F401  (re-exported:
+# OOB/F16_MAX are part of this module's historical API — routing, tier,
+# serve, and quant layers import them from here)
 from ..parallel.mesh import MeshContext
-
-# THE sharded-dispatch gate (adapm_tpu/exec, docs/EXECUTOR.md): every
-# sharded program dispatched by a store funnels through this one
-# process-wide mutex, so programs land on every device of the set in a
-# single global order — two servers sharing one virtual device set can
-# no longer deadlock XLA-CPU's collective rendezvous by dispatching
-# from different lock domains (the retired r10 known limit). Reentrant
-# and held for the ENQUEUE only (JAX dispatch is asynchronous).
-_GATE = dispatch_gate()
-
-# Out-of-range slot index for padding / masked entries: dropped by scatters
-# (mode="drop"), zero-filled by gathers (mode="fill").
-OOB = np.int32(2**31 - 2)
-
-# largest finite fp16 value: the compression wire formats clip to this
-# before any f16 cast (values/scales beyond it would cast to inf and
-# poison the EF loop with inf/NaN) — shared with tier/quant.py, whose
-# host transforms must match the device programs bitwise
-F16_MAX = 65504.0
 
 
 def bucket_size(n: int, minimum: int = 8) -> int:
@@ -82,173 +72,9 @@ def pad_bucket(n: int, *arrays_and_fills, minimum: int = 8):
 
 
 # ---------------------------------------------------------------------------
-# jitted programs (module level: jit cache shared across stores)
+# (the jitted data-plane programs formerly defined here live in
+# adapm_tpu/device/jaxport.py since ISSUE 14 — same names, same bits)
 # ---------------------------------------------------------------------------
-
-@jax.jit
-def _gather(main, cache, delta, o_shard, o_slot, c_shard, c_slot, use_cache):
-    """Pull: main rows for owner-served keys, cache+delta for replica-served
-    keys (o_slot is OOB for the latter to avoid pointless remote traffic)."""
-    m = main.at[o_shard, o_slot].get(mode="fill", fill_value=0)
-    c = (cache.at[c_shard, c_slot].get(mode="fill", fill_value=0)
-         + delta.at[c_shard, c_slot].get(mode="fill", fill_value=0))
-    return jnp.where(use_cache[:, None], c, m)
-
-
-@partial(jax.jit, donate_argnums=(0, 1))
-def _scatter_add(main, delta, o_shard, o_slot, d_shard, d_slot, vals):
-    """Push: each row routed either to main (owner path; d_slot=OOB) or to a
-    local replica's delta row (o_slot=OOB). Duplicate keys accumulate."""
-    main = main.at[o_shard, o_slot].add(vals, mode="drop")
-    delta = delta.at[d_shard, d_slot].add(vals, mode="drop")
-    return main, delta
-
-
-@partial(jax.jit, donate_argnums=(0, 1, 2))
-def _set_rows(main, cache, delta, o_shard, o_slot, vals, c_shard, c_slot):
-    """Set: overwrite the main copy; refresh the writer's local replica (if
-    any) and clear its pending delta so a local read observes the set value."""
-    main = main.at[o_shard, o_slot].set(vals, mode="drop")
-    cache = cache.at[c_shard, c_slot].set(vals, mode="drop")
-    delta = delta.at[c_shard, c_slot].set(jnp.zeros_like(vals), mode="drop")
-    return main, cache, delta
-
-
-@partial(jax.jit, donate_argnums=(1, 2))
-def _replica_create(main, cache, delta, o_shard, o_slot, c_shard, c_slot):
-    """Materialize replicas: copy current main rows into cache slots and zero
-    their deltas (reference registerNewIntentsForKeyUnsafe + first refresh,
-    handle.h:484-532, 776-840 — one program, since the single-controller
-    planner creates replicas synchronously)."""
-    rows = main.at[o_shard, o_slot].get(mode="fill", fill_value=0)
-    cache = cache.at[c_shard, c_slot].set(rows, mode="drop")
-    delta = delta.at[c_shard, c_slot].set(jnp.zeros_like(rows), mode="drop")
-    return cache, delta
-
-
-@partial(jax.jit, donate_argnums=(0, 1, 2))
-def _sync_replicas(main, cache, delta, r_shard, r_cslot, o_shard, o_slot):
-    """One sync round over a batch of replicas (reference SyncManager
-    startSync/ProcessSyncMessage, sync_manager.h:291-382, 553-799): extract
-    deltas -> merge into owners (scatter-add; multiple replicas of one key
-    all land) -> gather fresh values -> refresh bases, clear deltas."""
-    dvals = delta.at[r_shard, r_cslot].get(mode="fill", fill_value=0)
-    main = main.at[o_shard, o_slot].add(dvals, mode="drop")
-    fresh = main.at[o_shard, o_slot].get(mode="fill", fill_value=0)
-    cache = cache.at[r_shard, r_cslot].set(fresh, mode="drop")
-    delta = delta.at[r_shard, r_cslot].set(jnp.zeros_like(fresh), mode="drop")
-    return main, cache, delta
-
-
-@partial(jax.jit, donate_argnums=(0, 1, 2), static_argnames=("mode",))
-def _sync_replicas_compressed(main, cache, delta, r_shard, r_cslot,
-                              o_shard, o_slot, threshold, *, mode):
-    """_sync_replicas shipping QUANTIZED deltas with per-key error
-    feedback (--sys.sync.compress; ISSUE 8 tentpole, half b). The wire
-    transform is applied in-program: the owner merges what a receiver
-    would reconstruct from the fp16 / int8+fp16-scale payload — half /
-    quarter the future-DCN bytes per round — and the quantization
-    remainder is PARKED IN THE REPLICA'S DELTA ROW instead of zeroed
-    (the EF-SGD residual loop): it rides into the next shipped round,
-    so the main copy's long-run sum stays unbiased and a replica read
-    (cache + delta = fresh + residual) keeps read-your-writes to
-    within half a grid step. Sub-grid residuals of replicas that go
-    CLEAN are flushed exactly by the drop/quiesce paths, which bypass
-    compression (core/kv.py _sync_replicas). threshold composes like
-    _sync_replicas_thresholded: held rows keep their full delta.
-    Returns (main, cache, delta, max-abs parked residual) — the norm
-    feeds the sync.ef_residual_norm gauge without a blocking readback
-    (converted lazily at snapshot time)."""
-    dvals = delta.at[r_shard, r_cslot].get(mode="fill", fill_value=0)
-    ship = jnp.max(jnp.abs(dvals), axis=1) >= threshold
-    # overflow guard (must match quant.py's host twins bitwise): a
-    # delta beyond the fp16 range would cast to inf, merge an inf into
-    # the owner row FOREVER and park a -inf residual — clip to the
-    # format's max instead; the clipped excess rides the residual and
-    # ships over subsequent rounds (the EF loop absorbs saturation the
-    # same way it absorbs rounding)
-    if mode == "fp16":
-        shipped = jnp.clip(dvals, -F16_MAX, F16_MAX).astype(
-            jnp.float16).astype(dvals.dtype)
-    else:  # int8, symmetric per-row scale rounded through the f16 wire
-        s = jnp.clip(jnp.max(jnp.abs(dvals), axis=1) / 127.0,
-                     0.0, F16_MAX).astype(jnp.float16).astype(dvals.dtype)
-        safe = jnp.where(s > 0, s, 1.0)
-        q = jnp.clip(jnp.round(dvals / safe[:, None]), -127, 127)
-        shipped = q.astype(jnp.int8).astype(dvals.dtype) * s[:, None]
-    resid = dvals - shipped
-    rs = jnp.where(ship, r_cslot, OOB)
-    osl = jnp.where(ship, o_slot, OOB)
-    main = main.at[o_shard, osl].add(shipped, mode="drop")
-    fresh = main.at[o_shard, osl].get(mode="fill", fill_value=0)
-    cache = cache.at[r_shard, rs].set(fresh, mode="drop")
-    new_delta = jnp.where(ship[:, None], resid, dvals)
-    delta = delta.at[r_shard, r_cslot].set(new_delta, mode="drop")
-    resid_norm = jnp.max(jnp.where(ship[:, None], jnp.abs(resid), 0.0))
-    return main, cache, delta, resid_norm
-
-
-@partial(jax.jit, donate_argnums=(0, 1, 2))
-def _sync_replicas_thresholded(main, cache, delta, r_shard, r_cslot,
-                               o_shard, o_slot, threshold):
-    """_sync_replicas with the reference's sync threshold
-    (--sys.sync.threshold, handle.h:601-662, sync_manager.h:805-814): a
-    replica whose pending delta is small (max-abs below threshold) is left
-    out of the round entirely — no owner merge, no refresh — so tiny updates
-    keep accumulating locally instead of paying sync traffic. The delta is
-    never lost: it ships in a later round once it grows, or unconditionally
-    on drop/quiesce."""
-    dvals = delta.at[r_shard, r_cslot].get(mode="fill", fill_value=0)
-    ship = jnp.max(jnp.abs(dvals), axis=1) >= threshold
-    r_cslot = jnp.where(ship, r_cslot, OOB)
-    o_slot = jnp.where(ship, o_slot, OOB)
-    main = main.at[o_shard, o_slot].add(dvals, mode="drop")
-    fresh = main.at[o_shard, o_slot].get(mode="fill", fill_value=0)
-    cache = cache.at[r_shard, r_cslot].set(fresh, mode="drop")
-    delta = delta.at[r_shard, r_cslot].set(jnp.zeros_like(fresh), mode="drop")
-    return main, cache, delta
-
-
-@jax.jit
-def _read_rows_at(arr, sh, sl):
-    return arr.at[sh, sl].get(mode="fill", fill_value=0)
-
-
-@partial(jax.jit, donate_argnums=(0, 1))
-def _install_rows(cache, delta, c_shard, c_slot, vals):
-    """Install replica base rows received from a remote owner: set the base,
-    zero the pending delta (cross-process replica creation; the local-owner
-    twin is _replica_create)."""
-    cache = cache.at[c_shard, c_slot].set(vals, mode="drop")
-    delta = delta.at[c_shard, c_slot].set(jnp.zeros_like(vals), mode="drop")
-    return cache, delta
-
-
-@partial(jax.jit, donate_argnums=(0, 1))
-def _refresh_after_sync(cache, delta, c_shard, c_slot, fresh, shipped):
-    """Finish a cross-process sync round: install the owner's fresh value as
-    the new base and subtract exactly the shipped delta (pushes that landed
-    between extraction and refresh stay pending). Readers see base+delta
-    throughout, so a local value never dips below what this worker already
-    pushed — the moral equivalent of the reference keeping `val` intact and
-    only advancing `sync_state` (handle.h:601-662)."""
-    cache = cache.at[c_shard, c_slot].set(fresh, mode="drop")
-    delta = delta.at[c_shard, c_slot].add(-shipped, mode="drop")
-    return cache, delta
-
-
-@partial(jax.jit, donate_argnums=(0, 1))
-def _relocate(main, delta, old_shard, old_slot, new_shard, new_slot,
-              rc_shard, rc_slot):
-    """Relocation: move rows old->new; if the destination shard held a
-    replica, merge its pending delta (replica->owner upgrade, reference
-    refreshUpgradeReplicaUnsafe handle.h:776-840). All gathers happen before
-    all scatters, so intra-batch slot reuse is safe."""
-    rows = main.at[old_shard, old_slot].get(mode="fill", fill_value=0)
-    rows = rows + delta.at[rc_shard, rc_slot].get(mode="fill", fill_value=0)
-    main = main.at[new_shard, new_slot].set(rows, mode="drop")
-    delta = delta.at[rc_shard, rc_slot].set(jnp.zeros_like(rows), mode="drop")
-    return main, delta
 
 
 # ---------------------------------------------------------------------------
@@ -302,12 +128,16 @@ class ShardedStore:
     """Pools for one length class. Index-level API; key routing lives above."""
 
     def __init__(self, num_keys_in_class: int, value_length: int,
-                 ctx: MeshContext, dtype=jnp.float32, over_alloc: float = 1.25,
+                 ctx: MeshContext, dtype=np.float32, over_alloc: float = 1.25,
                  cache_slots_per_shard: int = 0, bucket_min: int = 8,
-                 tier_hot_rows: int = 0, tier_cold_dtype: str = "fp32"):
+                 tier_hot_rows: int = 0, tier_cold_dtype: str = "fp32",
+                 port=None):
         self.value_length = value_length
         self.ctx = ctx
         self.dtype = dtype
+        # the device plane (ISSUE 14): every program dispatch below goes
+        # through this narrow port — swap it to target a new backend
+        self.port = port if port is not None else default_port()
         # min padded batch size (--sys equivalent: remote_bucket_min) — a
         # larger floor means fewer distinct bucket shapes, i.e. fewer XLA
         # compilations, at the cost of padding work on tiny batches
@@ -364,13 +194,15 @@ class ShardedStore:
             if tier_cold_dtype == "fp32":
                 self.cold = self.coldq.q
 
+        # donation-aware pool allocation through the port: the returned
+        # buffers are the roots of the donated program chain
         sh = ctx.shard0()
-        self.main = jax.device_put(
-            jnp.zeros((S, dev_main_slots, value_length), dtype), sh)
-        self.cache = jax.device_put(
-            jnp.zeros((S, self.cache_slots, value_length), dtype), sh)
-        self.delta = jax.device_put(
-            jnp.zeros((S, self.cache_slots, value_length), dtype), sh)
+        self.main = self.port.alloc_pool(
+            (S, dev_main_slots, value_length), dtype, sh)
+        self.cache = self.port.alloc_pool(
+            (S, self.cache_slots, value_length), dtype, sh)
+        self.delta = self.port.alloc_pool(
+            (S, self.cache_slots, value_length), dtype, sh)
 
         # -- dirty-delta tracking (host-side, PR 3 tentpole) ---------------
         # NOTE (PR 5, tiering): the epochs below are indexed by SLOT,
@@ -506,8 +338,7 @@ class ShardedStore:
         a = pad_bucket(n, (o_shard, 0), (o_slot, OOB), (c_shard, 0),
                        (c_slot, OOB), (use_cache, False),
                        minimum=self.bucket_min)
-        with _GATE:
-            return _gather(self.main, self.cache, self.delta, *a)
+        return self.port.gather(self.main, self.cache, self.delta, *a)
 
     def stage_gather(self, o_shard, o_slot, c_shard, c_slot, use_cache,
                      pool: "StagingPool"):
@@ -543,9 +374,8 @@ class ShardedStore:
         a = pad_bucket(n, (o_shard, 0), (o_slot, OOB), (d_shard, 0),
                        (d_slot, OOB), minimum=self.bucket_min)
         v = self._vals_bucket(vals, a[0].shape[0])
-        with _GATE:
-            self.main, self.delta = _scatter_add(self.main, self.delta,
-                                                 *a, v)
+        self.main, self.delta = self.port.scatter_add(
+            self.main, self.delta, *a, v)
 
     def set_rows(self, o_shard, o_slot, vals, c_shard, c_slot):
         n = len(o_shard)
@@ -570,10 +400,9 @@ class ShardedStore:
         a = pad_bucket(n, (o_shard, 0), (o_slot, OOB), (c_shard, 0),
                        (c_slot, OOB), minimum=self.bucket_min)
         v = self._vals_bucket(vals, a[0].shape[0])
-        with _GATE:
-            self.main, self.cache, self.delta = _set_rows(
-                self.main, self.cache, self.delta, a[0], a[1], v,
-                a[2], a[3])
+        self.main, self.cache, self.delta = self.port.set_rows(
+            self.main, self.cache, self.delta, a[0], a[1], v,
+            a[2], a[3])
 
     def replica_create(self, o_shard, o_slot, c_shard, c_slot):
         n = len(o_shard)
@@ -588,9 +417,8 @@ class ShardedStore:
             return
         a = pad_bucket(n, (o_shard, 0), (o_slot, OOB), (c_shard, 0),
                        (c_slot, OOB), minimum=self.bucket_min)
-        with _GATE:
-            self.cache, self.delta = _replica_create(
-                self.main, self.cache, self.delta, *a)
+        self.cache, self.delta = self.port.replica_create(
+            self.main, self.cache, self.delta, *a)
 
     def sync_replicas(self, r_shard, r_cslot, o_shard, o_slot,
                       threshold: float = 0.0, compress: str = "off"):
@@ -630,20 +458,14 @@ class ShardedStore:
             return
         a = pad_bucket(n, (r_shard, 0), (r_cslot, OOB), (o_shard, 0),
                        (o_slot, OOB), minimum=self.bucket_min)
-        with _GATE:
-            if compress != "off":
-                (self.main, self.cache, self.delta,
-                 self._ef_resid_dev) = _sync_replicas_compressed(
-                    self.main, self.cache, self.delta, *a,
-                    jnp.asarray(threshold, self.dtype), mode=compress)
-            elif threshold > 0.0:
-                self.main, self.cache, self.delta = \
-                    _sync_replicas_thresholded(
-                        self.main, self.cache, self.delta, *a,
-                        jnp.asarray(threshold, self.dtype))
-            else:
-                self.main, self.cache, self.delta = _sync_replicas(
-                    self.main, self.cache, self.delta, *a)
+        out = self.port.sync_replicas(self.main, self.cache, self.delta,
+                                      *a, threshold=threshold,
+                                      compress=compress)
+        if compress != "off":
+            (self.main, self.cache, self.delta,
+             self._ef_resid_dev) = out
+        else:
+            self.main, self.cache, self.delta = out
 
     def ef_residual_norm(self) -> float:
         """Max-abs residual parked by the most recent compressed sync
@@ -677,8 +499,8 @@ class ShardedStore:
         a = pad_bucket(n, (old_shard, 0), (old_slot, OOB), (new_shard, 0),
                        (new_slot, OOB), (rc_shard, 0), (rc_slot, OOB),
                        minimum=self.bucket_min)
-        with _GATE:
-            self.main, self.delta = _relocate(self.main, self.delta, *a)
+        self.main, self.delta = self.port.relocate(
+            self.main, self.delta, *a)
 
     # -- cross-process helpers (parallel/pm.py GlobalPM) ---------------------
 
@@ -694,8 +516,7 @@ class ShardedStore:
         a = pad_bucket(n, (sh, 0), (sl, OOB), minimum=self.bucket_min)
         arr = {"main": self.main, "cache": self.cache,
                "delta": self.delta}[which]
-        with _GATE:
-            rows = _read_rows_at(arr, *a)
+        rows = self.port.read_rows_at(arr, *a)
         return np.asarray(rows)[:n]
 
     # -- tiered-residency helpers (adapm_tpu/tier; no-ops untiered) ----------
@@ -705,8 +526,7 @@ class ShardedStore:
         demotion/relocation readback; non-destructive)."""
         n = len(sh)
         a = pad_bucket(n, (sh, 0), (row, OOB), minimum=self.bucket_min)
-        with _GATE:
-            rows = _read_rows_at(self.main, *a)
+        rows = self.port.read_rows_at(self.main, *a)
         return np.asarray(rows)[:n]
 
     def main_host(self) -> np.ndarray:
@@ -735,19 +555,17 @@ class ShardedStore:
         a = pad_bucket(n, (c_shard, 0), (c_slot, OOB),
                        minimum=self.bucket_min)
         v = self._vals_bucket(vals, a[0].shape[0])
-        with _GATE:
-            self.cache, self.delta = _install_rows(self.cache,
-                                                   self.delta, *a, v)
+        self.cache, self.delta = self.port.install_rows(
+            self.cache, self.delta, *a, v)
 
     def refresh_after_sync(self, c_shard, c_slot, fresh, shipped) -> None:
         n = len(c_shard)
         a = pad_bucket(n, (c_shard, 0), (c_slot, OOB),
                        minimum=self.bucket_min)
         b = a[0].shape[0]
-        with _GATE:
-            self.cache, self.delta = _refresh_after_sync(
-                self.cache, self.delta, *a,
-                self._vals_bucket(fresh, b), self._vals_bucket(shipped, b))
+        self.cache, self.delta = self.port.refresh_after_sync(
+            self.cache, self.delta, *a,
+            self._vals_bucket(fresh, b), self._vals_bucket(shipped, b))
 
     def block(self) -> None:
         jax.block_until_ready((self.main, self.cache, self.delta))

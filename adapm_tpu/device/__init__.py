@@ -1,0 +1,38 @@
+"""The episodic device plane (ISSUE 14; ROADMAP item 4).
+
+    port.py    — the narrow DevicePort protocol: gather/scatter/
+                 fused-step/collective program construction, donation-
+                 aware buffer alloc, quantized wire-row ingest. ONE
+                 port implementation per accelerator backend; the rest
+                 of the tree never touches jax.jit/device_put directly
+                 (adapm-lint APM008: device-API confinement).
+    jaxport.py — JaxDevicePort, the shipping jax/XLA implementation
+                 (every jitted data-plane program lives here).
+    episode.py — episodic execution (GraphVite-style): partition the
+                 step stream into episodes, pin an episode's hot set
+                 via the tier promotion path, and double-buffer host
+                 prep of episode N+1 against device compute of episode
+                 N on the `episode`/`episode_commit` executor streams.
+
+`default_port()` is the process-wide port (lazy; importing the package
+never initializes the device stack).
+"""
+from __future__ import annotations
+
+from .port import DevicePort, default_port, set_default_port  # noqa: F401
+
+
+def _jax_symbols():
+    from . import jaxport
+    return jaxport
+
+
+def __getattr__(name):
+    # lazy re-exports: OOB/F16_MAX and the concrete port class live in
+    # jaxport, which imports jax — keep `import adapm_tpu.device` cheap
+    if name in ("OOB", "F16_MAX", "JaxDevicePort"):
+        return getattr(_jax_symbols(), name)
+    if name in ("EpisodicRunner", "plan_episodes"):
+        from . import episode
+        return getattr(episode, name)
+    raise AttributeError(name)

@@ -515,10 +515,7 @@ def restore_chain(server, path: str,
 
 
 def _apply_chain(server, chain: List[Tuple[Dict, Dict]]) -> None:
-    import jax
-
-    from ..utils.checkpoint import (_launder, _rebuild_alloc,
-                                    _rebuild_cache_alloc)
+    from ..utils.checkpoint import _rebuild_alloc, _rebuild_cache_alloc
     # latest version of each aux table across the chain (links skip
     # unchanged tables)
     aux: Dict[str, np.ndarray] = {}
@@ -561,7 +558,7 @@ def _apply_chain(server, chain: List[Tuple[Dict, Dict]]) -> None:
                 from ..tier.coldpath import install_main_full
                 install_main_full(st, full)
             else:
-                st.main = _launder(jax.device_put(full, st.ctx.shard0()))
+                st.main = st.port.install_pool(full, st.ctx.shard0())
             # replicas: clean ones are bitwise cache==main, delta==0;
             # the final link's captured dirty rows overlay that
             S = st.ctx.num_shards
@@ -580,8 +577,8 @@ def _apply_chain(server, chain: List[Tuple[Dict, Dict]]) -> None:
                 cache_host[rsh, rcs] = final[f"rcache_{cid}"]
                 delta_host[rsh, rcs] = final[f"rdelta_{cid}"]
             sh0 = st.ctx.shard0()
-            st.cache = _launder(jax.device_put(cache_host, sh0))
-            st.delta = _launder(jax.device_put(delta_host, sh0))
+            st.cache = st.port.install_pool(cache_host, sh0)
+            st.delta = st.port.install_pool(delta_host, sh0)
 
         for cid in range(len(server.stores)):
             class_keys = np.nonzero(ab.key_class == cid)[0]

@@ -19,6 +19,8 @@ catalog — rule ID, rationale, what fires, how to suppress.
 |        | enqueue under it requires an under-lock re-read              |
 | APM007 | metric-catalog drift: registered metric names <-> the        |
 |        | docs/OBSERVABILITY.md catalog + snapshot schema sections     |
+| APM008 | device-API confinement: jax.jit / device_put / pmap /        |
+|        | shard_map only under adapm_tpu/device/ (the DevicePort)      |
 
 Rules are LEXICAL: they reason about the AST as written (a `with
 dispatch_gate():` block, an `is None` test), not about runtime values.
@@ -135,7 +137,8 @@ SHARDED_DISPATCH_SITES = frozenset({
     "_sync_replicas", "_sync_replicas_compressed",
     "_sync_replicas_thresholded", "_read_rows_at", "_install_rows",
     "_refresh_after_sync", "_relocate",
-    # tier/promote.py + ops/dequant.py (promotion uploads)
+    # promotion uploads (device/jaxport.py; formerly tier/promote.py +
+    # ops/dequant.py)
     "_write_main_rows", "_write_main_rows_fp16", "_write_main_rows_int8",
     # tier/coldpath.py (cold-path programs)
     "_gather_cold", "_gather_cold_fp16", "_gather_cold_int8",
@@ -887,6 +890,110 @@ class MetricCatalogRule(Rule):
 
 
 # ---------------------------------------------------------------------------
+# APM008 — device-API confinement
+# ---------------------------------------------------------------------------
+
+# jax program-construction / transfer attributes (`jax.<attr>`) and
+# bare names whose use constitutes constructing a device program or
+# placing a buffer — the DevicePort surface (adapm_tpu/device/port.py).
+_DEVICE_API_ATTRS = frozenset({"jit", "device_put", "pmap"})
+_DEVICE_API_NAMES = frozenset({"shard_map"})
+
+# The one place allowed to touch the device APIs directly: the port
+# implementations. Everything else reaches the accelerator through a
+# DevicePort method (store dispatches, port.compile for fused steps,
+# port.compile_collective for exchanges, port.put_* for transfers), so
+# a new backend is one new port class — the ISSUE 14 refactor contract.
+DEVICE_PLANE_ALLOWLIST = ("adapm_tpu/device/",)
+
+
+class DeviceApiConfinementRule(Rule):
+    """APM008: `jax.jit` / `jax.device_put` / `jax.pmap` / `shard_map`
+    only under `adapm_tpu/device/`. A jit or device_put call anywhere
+    else re-opens the tree-wide-edit problem the DevicePort closed:
+    the next accelerator backend would have to find and port that site
+    too. Route program construction through `port.compile(...)` /
+    `port.compile_collective(...)`, transfers through `port.put_*` /
+    `port.install_pool`, and data-plane dispatch through the store's
+    port methods. Model-math / inherently-backend-specific modules
+    (KGE eval programs, Pallas kernels) carry justified suppressions,
+    never a widened allowlist (docs/INVARIANTS.md#apm008)."""
+
+    id = "APM008"
+    name = "device-api-confinement"
+    doc = "jax program-construction API outside adapm_tpu/device/"
+
+    @staticmethod
+    def _attr_root(node: ast.AST) -> Optional[str]:
+        """Root Name of an attribute chain (`jax.experimental.
+        shard_map.shard_map` -> "jax"); None for non-Name roots."""
+        while isinstance(node, ast.Attribute):
+            node = node.value
+        return node.id if isinstance(node, ast.Name) else None
+
+    def check_module(self, mod: ModuleInfo,
+                     ctx: ProjectContext) -> List[Finding]:
+        if any(mod.relpath.startswith(p)
+               for p in DEVICE_PLANE_ALLOWLIST):
+            return []
+        banned_attrs = _DEVICE_API_ATTRS | _DEVICE_API_NAMES
+        out = []
+        seen = set()  # (line, attr): a nested chain like
+        # jax.experimental.shard_map.shard_map matches twice
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Attribute) and \
+                    node.attr in banned_attrs and \
+                    self._attr_root(node.value) == "jax":
+                key = (node.lineno, node.attr)
+                if key in seen:
+                    continue
+                seen.add(key)
+                out.append(self.finding(
+                    mod, node.lineno,
+                    f"[device-api-confinement] jax …{node.attr} outside "
+                    f"adapm_tpu/device/ — construct programs through "
+                    f"the DevicePort (port.compile / port.put_* / the "
+                    f"store's dispatch methods) so a new accelerator "
+                    f"backend is one port implementation, not a "
+                    f"tree-wide edit (docs/INVARIANTS.md#apm008)"))
+            elif isinstance(node, ast.Name) and \
+                    node.id in _DEVICE_API_NAMES and \
+                    isinstance(node.ctx, ast.Load):
+                out.append(self.finding(
+                    mod, node.lineno,
+                    "[device-api-confinement] shard_map outside "
+                    "adapm_tpu/device/ — collective programs are "
+                    "constructed by port.compile_collective "
+                    "(docs/INVARIANTS.md#apm008)"))
+            elif isinstance(node, ast.ImportFrom):
+                names = {a.name for a in node.names}
+                banned = names & (_DEVICE_API_NAMES |
+                                  (_DEVICE_API_ATTRS
+                                   if (node.module or "") == "jax"
+                                   else frozenset()))
+                if banned:
+                    out.append(self.finding(
+                        mod, node.lineno,
+                        f"[device-api-confinement] importing "
+                        f"{sorted(banned)} outside adapm_tpu/device/ — "
+                        f"reach the device stack through the "
+                        f"DevicePort (docs/INVARIANTS.md#apm008)"))
+            elif isinstance(node, ast.Import):
+                # plain `import jax.experimental.shard_map` — the
+                # evasion form the attribute check alone would miss
+                mods = [a.name for a in node.names
+                        if set(a.name.split(".")) & banned_attrs]
+                if mods:
+                    out.append(self.finding(
+                        mod, node.lineno,
+                        f"[device-api-confinement] importing "
+                        f"{sorted(mods)} outside adapm_tpu/device/ — "
+                        f"reach the device stack through the "
+                        f"DevicePort (docs/INVARIANTS.md#apm008)"))
+        return out
+
+
+# ---------------------------------------------------------------------------
 
 
 def default_rules() -> List[Rule]:
@@ -899,4 +1006,5 @@ def default_rules() -> List[Rule]:
         DonationAfterDispatchRule(),
         RevalidateBeforeEnqueueRule(),
         MetricCatalogRule(),
+        DeviceApiConfinementRule(),
     ]

@@ -162,6 +162,9 @@ def make_true_score(model: str):
     a subgraph inside differently-shaped programs does not."""
     score = {"complex": complex_score, "rescal": rescal_score}[model]
 
+    # apm-lint: disable=APM008 model-math eval program over already-
+    # gathered rows: backend-generic jax compute, no pool donation and no
+    # sharded dispatch — the PM data plane proper rides the DevicePort
     @jax.jit
     def fn(se, re_, oe):
         return score(se, re_, oe)
@@ -192,6 +195,9 @@ def make_pool_eval_counts_mp(model: str, ent_dim: int, rel_dim: int,
        greater_s [B])."""
     scores_fn = make_eval_scores(model)
 
+    # apm-lint: disable=APM008 chunked eval-count program (model math
+    # over the shared pool mirror): backend-generic jax, not a PM
+    # data-plane dispatch site
     @jax.jit
     def counts(ent_main, tables, ent_keys, nvalid, se, re_, oe, skeys,
                okeys, true_sc):
@@ -255,6 +261,8 @@ def make_pool_eval_counts(model: str, ent_dim: int, rel_dim: int,
     score = {"complex": complex_score, "rescal": rescal_score}[model]
     scores_fn = make_eval_scores(model)
 
+    # apm-lint: disable=APM008 pool-eval count program (model math):
+    # backend-generic jax, not a PM data-plane dispatch site
     @jax.jit
     def counts(ent_main, rel_main, tables, ent_keys, nE, skeys, rkeys,
                okeys):

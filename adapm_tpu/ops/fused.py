@@ -42,7 +42,6 @@ eliminating the per-step sample key transfer too.
 """
 from __future__ import annotations
 
-from functools import partial
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -50,6 +49,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.store import OOB
+from ..device import default_port
 from ..exec import dispatch_gate
 
 # sharded-dispatch serialization (adapm_tpu/exec, docs/EXECUTOR.md):
@@ -186,7 +186,6 @@ def make_fused_adagrad_step(
     roles = sorted(role_class)
     trainable = [r for r in roles if r not in frozen_roles]
 
-    @partial(jax.jit, donate_argnums=(0,))
     def step(pools, routes, aux, lr, eps):
         rows = {}
         for r in roles:
@@ -217,7 +216,9 @@ def make_fused_adagrad_step(
             new_pools[cid] = (main, cache, delta)
         return tuple(new_pools), loss
 
-    return step
+    # program construction through the DevicePort (ISSUE 14): the body
+    # is model math; the port owns how it becomes a device program
+    return default_port().compile(step, donate_argnums=(0,))
 
 
 class DeviceRouter:
@@ -317,7 +318,7 @@ def make_device_routed_step(loss_fn: Callable[..., jnp.ndarray],
     # saves nothing and its aliased buffer has been observed returning
     # stale/garbage counts on the multi-device CPU backend (flaky
     # locality_counts mismatches in test_device_routed)
-    return jax.jit(body, donate_argnums=(0,))
+    return default_port().compile(body, donate_argnums=(0,))
 
 
 def make_device_routed_scan(loss_fn: Callable[..., jnp.ndarray],
@@ -345,7 +346,6 @@ def make_device_routed_scan(loss_fn: Callable[..., jnp.ndarray],
         neg_shape, no_replicas, neg_alias)
 
     # pools-only donation, same rationale as make_device_routed_step
-    @partial(jax.jit, donate_argnums=(0,))
     def scan(pools, locstat, tables, keys, local_index, alias, rng_keys,
              aux, lr, eps):
         def f(carry, xs):
@@ -364,7 +364,7 @@ def make_device_routed_scan(loss_fn: Callable[..., jnp.ndarray],
         (pools, locstat), losses = jax.lax.scan(f, (pools, locstat), xs)
         return pools, locstat, losses
 
-    return scan
+    return default_port().compile(scan, donate_argnums=(0,))
 
 
 def _build_device_routed_body(loss_fn, role_class, role_dim, shard,

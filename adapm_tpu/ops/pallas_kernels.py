@@ -29,6 +29,9 @@ def _copy_kernel(idx_ref, blk_ref, o_ref):
     o_ref[:] = blk_ref[:]
 
 
+# apm-lint: disable=APM008 standalone Pallas TPU kernel (inherently
+# backend-specific by definition): benchmarked in isolation, never
+# dispatched by the PM planes — porting it IS writing a new backend
 @functools.partial(jax.jit, static_argnames=("block_rows", "interpret"))
 def gather_rows(pool: jnp.ndarray, block_idx: jnp.ndarray,
                 block_rows: int = 8, interpret: bool = False) -> jnp.ndarray:
@@ -67,6 +70,8 @@ def _adagrad_kernel(g_ref, emb_ref, acc_ref, lr_ref, eps_ref,
         acc + eps_ref[0])
 
 
+# apm-lint: disable=APM008 standalone Pallas TPU kernel, same rationale
+# as gather_rows above
 @functools.partial(jax.jit, static_argnames=("block", "interpret"))
 def adagrad_apply(grads: jnp.ndarray, emb: jnp.ndarray, acc: jnp.ndarray,
                   lr: float, eps: float = 1e-10, block: int = 256,

@@ -28,7 +28,7 @@ counts with empty-padded buckets where they have nothing to send.
 """
 from __future__ import annotations
 
-from functools import partial
+
 from typing import Dict, List, Tuple
 
 import numpy as np
@@ -112,23 +112,21 @@ class CollectiveSync:
     def _fn(self, nleaves: int):
         import jax
         from jax.sharding import PartitionSpec
-        # jax.shard_map graduated from jax.experimental.shard_map; this
-        # image's jax predates the top-level alias
-        shard_map = getattr(jax, "shard_map", None)
-        if shard_map is None:
-            from jax.experimental.shard_map import shard_map
+
+        from ..device import default_port
         fn = self._fns.get(nleaves)
         if fn is None:
-            @jax.jit
-            @partial(shard_map, mesh=self._mesh,
-                     in_specs=PartitionSpec("p"),
-                     out_specs=PartitionSpec("p"))
             def xchg(tree):
                 def one(x):  # local block [1, P, B, ...]
                     return jax.lax.all_to_all(x[0], "p", 0, 0)[None]
                 return jax.tree_util.tree_map(one, tree)
 
-            fn = self._fns[nleaves] = xchg
+            # collective-program construction through the DevicePort
+            # (ISSUE 14): the port owns the shard_map/jit plumbing (and
+            # the jax.shard_map vs jax.experimental fallback)
+            fn = self._fns[nleaves] = default_port().compile_collective(
+                xchg, mesh=self._mesh, in_specs=PartitionSpec("p"),
+                out_specs=PartitionSpec("p"))
         return fn
 
     def exchange(self, local_tree):
@@ -143,9 +141,12 @@ class CollectiveSync:
         import jax
         P = self._P
 
+        from ..device import default_port
+        port = default_port()
+
         def to_global(x):
             x = np.ascontiguousarray(x)
-            blk = jax.device_put(x[None], self._mine)
+            blk = port.put_single(x[None], self._mine)
             return jax.make_array_from_single_device_arrays(
                 (P,) + x.shape, self._sharding, [blk])
 

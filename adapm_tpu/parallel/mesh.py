@@ -54,8 +54,10 @@ class MeshContext:
         executable per call, and a bare numpy arg uploads synchronously
         inside dispatch on remote-attached backends; a replicated
         device_put is asynchronous and already in the sharding
-        executables expect."""
-        return jax.device_put(np.asarray(arr), self.replicated())
+        executables expect. Routed through the DevicePort (ISSUE 14) —
+        late import: the device plane sits above the mesh layer."""
+        from ..device import default_port
+        return default_port().put_replicated(arr, self.replicated())
 
 
 def make_mesh(num_shards: Optional[int] = None,

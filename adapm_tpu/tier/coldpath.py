@@ -25,70 +25,20 @@ pre-PR pin) and whose fp16/int8 modes follow the error-compensated
 contract in docs/MEMORY.md — the visible value of a cold row is its
 dequantized stored value, identical through the dequant-fused device
 gather (ops/dequant.py) and the host read paths here.
+
+Since ISSUE 14 every device program below dispatches through the
+store's DevicePort (adapm_tpu/device) — the cold-override gather, the
+dequant-fused wire gathers, and the refresh installs are port methods;
+this module is device-API-free (adapm-lint APM008) and pays only the
+host-side residency work.
 """
 from __future__ import annotations
 
 import time
-from functools import partial
 
-import jax
-import jax.numpy as jnp
 import numpy as np
 
-from ..core import store as store_mod
 from ..core.store import OOB, pad_bucket
-from ..exec import dispatch_gate
-
-# sharded-dispatch serialization (docs/EXECUTOR.md): the gate brackets
-# each individual program ENQUEUE below — never the blocking
-# device->host readbacks or host-side merges these paths pay (holding
-# it across a readback would stall every other thread's dispatch
-# process-wide for the readback's duration)
-_GATE = dispatch_gate()
-
-# ---------------------------------------------------------------------------
-# jitted helpers (module level: jit cache shared across stores)
-# ---------------------------------------------------------------------------
-
-
-@jax.jit
-def _gather_cold(main, cache, delta, o_shard, o_row, c_shard, c_slot,
-                 use_cache, cold_vals, use_cold):
-    """`store._gather` with a host-supplied row override: entries whose
-    owner row is cold read `cold_vals` (bit-exact select)."""
-    m = main.at[o_shard, o_row].get(mode="fill", fill_value=0)
-    m = jnp.where(use_cold[:, None], cold_vals, m)
-    c = (cache.at[c_shard, c_slot].get(mode="fill", fill_value=0)
-         + delta.at[c_shard, c_slot].get(mode="fill", fill_value=0))
-    return jnp.where(use_cache[:, None], c, m)
-
-
-@partial(jax.jit, donate_argnums=(0,))
-def _clear_rows(arr, sh, sl):
-    """Zero rows (relocation's replica-delta consume on the host path)."""
-    return arr.at[sh, sl].set(
-        jnp.zeros((sh.shape[0], arr.shape[-1]), arr.dtype), mode="drop")
-
-
-@partial(jax.jit, donate_argnums=(0, 1))
-def _install_cache_rows(cache, delta, c_shard, c_slot, vals):
-    """Set replica bases to `vals` and zero their deltas (the cold
-    sync's refresh half; same program shape as store._install_rows but
-    without the cross-process tracking semantics)."""
-    cache = cache.at[c_shard, c_slot].set(vals, mode="drop")
-    delta = delta.at[c_shard, c_slot].set(jnp.zeros_like(vals), mode="drop")
-    return cache, delta
-
-
-@partial(jax.jit, donate_argnums=(0, 1))
-def _install_cache_rows_resid(cache, delta, c_shard, c_slot, vals, resid):
-    """Compressed cold-owner sync refresh: install the fresh base and
-    PARK the quantization residual in the delta row instead of zeroing
-    it (the EF loop's host twin of _sync_replicas_compressed)."""
-    cache = cache.at[c_shard, c_slot].set(vals, mode="drop")
-    delta = delta.at[c_shard, c_slot].set(resid, mode="drop")
-    return cache, delta
-
 
 # ---------------------------------------------------------------------------
 # residency resolution
@@ -144,9 +94,8 @@ def gather_tiered(store, o_shard, o_slot, c_shard, c_slot, use_cache):
                    (c_shard, 0), (c_slot, OOB), (use_cache, False),
                    minimum=store.bucket_min)
     if not cold.any():
-        with _GATE:
-            return store_mod._gather(store.main, store.cache,
-                                     store.delta, *a)
+        return store.port.gather(store.main, store.cache,
+                                 store.delta, *a)
     t0 = time.perf_counter()
     b = a[0].shape[0]
     use_cold = np.zeros(b, dtype=bool)
@@ -156,29 +105,23 @@ def gather_tiered(store, o_shard, o_slot, c_shard, c_slot, use_cache):
         cold_vals = np.zeros((b, store.value_length),
                              dtype=np.dtype(store.dtype))
         cold_vals[:n][cold] = store.coldq.read(o_sh[cold], o_sl[cold])
-        with _GATE:
-            out = _gather_cold(store.main, store.cache, store.delta, *a,
-                               cold_vals, use_cold)
+        out = store.port.gather_cold(store.main, store.cache,
+                                     store.delta, *a, cold_vals,
+                                     use_cold)
     else:
-        # dequant-fused cold-miss gather (ops/dequant.py): ship the
-        # WIRE rows — half/quarter the host->device bytes — and invert
-        # the format inside the gather program itself
-        from ..ops import dequant
+        # dequant-fused cold-miss gather (the port's wire ingest): ship
+        # the WIRE rows — half/quarter the host->device bytes — and
+        # invert the format inside the gather program itself
         q, s = store.coldq.wire(o_sh[cold], o_sl[cold])
         qbuf = np.zeros((b, store.value_length), dtype=q.dtype)
         qbuf[:n][cold] = q
-        if mode == "fp16":
-            with _GATE:
-                out = dequant._gather_cold_fp16(
-                    store.main, store.cache, store.delta, *a,
-                    qbuf, use_cold)
-        else:
+        sbuf = None
+        if mode != "fp16":
             sbuf = np.zeros(b, dtype=np.float32)
             sbuf[:n][cold] = s
-            with _GATE:
-                out = dequant._gather_cold_int8(
-                    store.main, store.cache, store.delta, *a,
-                    qbuf, sbuf, use_cold)
+        out = store.port.gather_cold_wire(
+            mode, store.main, store.cache, store.delta, *a,
+            qbuf, sbuf, use_cold)
     if store.tier_hist is not None:
         store.tier_hist.observe(time.perf_counter() - t0)
     return out
@@ -200,9 +143,8 @@ def scatter_add_tiered(store, o_shard, o_slot, d_shard, d_slot, vals):
     a = pad_bucket(n, (o_sh.astype(np.int32), 0), (g_row, OOB),
                    (d_shard, 0), (d_slot, OOB), minimum=store.bucket_min)
     v = store._vals_bucket(rows, a[0].shape[0])
-    with _GATE:
-        store.main, store.delta = store_mod._scatter_add(
-            store.main, store.delta, *a, v)
+    store.main, store.delta = store.port.scatter_add(
+        store.main, store.delta, *a, v)
 
 
 def set_rows_tiered(store, o_shard, o_slot, vals, c_shard, c_slot):
@@ -218,10 +160,9 @@ def set_rows_tiered(store, o_shard, o_slot, vals, c_shard, c_slot):
     a = pad_bucket(n, (o_sh.astype(np.int32), 0), (g_row, OOB),
                    (c_shard, 0), (c_slot, OOB), minimum=store.bucket_min)
     v = store._vals_bucket(rows, a[0].shape[0])
-    with _GATE:
-        store.main, store.cache, store.delta = store_mod._set_rows(
-            store.main, store.cache, store.delta, a[0], a[1], v,
-            a[2], a[3])
+    store.main, store.cache, store.delta = store.port.set_rows(
+        store.main, store.cache, store.delta, a[0], a[1], v,
+        a[2], a[3])
 
 
 def replica_create_tiered(store, o_shard, o_slot, c_shard, c_slot):
@@ -238,9 +179,8 @@ def replica_create_tiered(store, o_shard, o_slot, c_shard, c_slot):
                        (o_sh[hot].astype(np.int32), 0), (g_row[hot], OOB),
                        (c_sh[hot], 0), (c_sl[hot], OOB),
                        minimum=store.bucket_min)
-        with _GATE:
-            store.cache, store.delta = store_mod._replica_create(
-                store.main, store.cache, store.delta, *a)
+        store.cache, store.delta = store.port.replica_create(
+            store.main, store.cache, store.delta, *a)
     if cold.any():
         # a fresh replica copies the VISIBLE cold value (deq only —
         # the parked residual stays with the owner row)
@@ -248,9 +188,8 @@ def replica_create_tiered(store, o_shard, o_slot, c_shard, c_slot):
         a = pad_bucket(int(cold.sum()), (c_sh[cold], 0), (c_sl[cold], OOB),
                        minimum=store.bucket_min)
         v = store._vals_bucket(vals, a[0].shape[0])
-        with _GATE:
-            store.cache, store.delta = _install_cache_rows(
-                store.cache, store.delta, *a, v)
+        store.cache, store.delta = store.port.install_cache_rows(
+            store.cache, store.delta, *a, v)
 
 
 def sync_replicas_tiered(store, r_shard, r_cslot, o_shard, o_slot,
@@ -273,23 +212,14 @@ def sync_replicas_tiered(store, r_shard, r_cslot, o_shard, o_slot,
         a = pad_bucket(int(hot.sum()), (r_sh[hot], 0), (r_cs[hot], OOB),
                        (o_sh[hot].astype(np.int32), 0), (g_row[hot], OOB),
                        minimum=store.bucket_min)
-        with _GATE:
-            if compress != "off":
-                (store.main, store.cache, store.delta,
-                 store._ef_resid_dev) = \
-                    store_mod._sync_replicas_compressed(
-                        store.main, store.cache, store.delta, *a,
-                        jnp.asarray(threshold, store.dtype),
-                        mode=compress)
-            elif threshold > 0.0:
-                store.main, store.cache, store.delta = \
-                    store_mod._sync_replicas_thresholded(
-                        store.main, store.cache, store.delta, *a,
-                        jnp.asarray(threshold, store.dtype))
-            else:
-                store.main, store.cache, store.delta = \
-                    store_mod._sync_replicas(
-                        store.main, store.cache, store.delta, *a)
+        out = store.port.sync_replicas(
+            store.main, store.cache, store.delta, *a,
+            threshold=threshold, compress=compress)
+        if compress != "off":
+            (store.main, store.cache, store.delta,
+             store._ef_resid_dev) = out
+        else:
+            store.main, store.cache, store.delta = out
     if not cold.any():
         return
     t0 = time.perf_counter()
@@ -322,15 +252,10 @@ def sync_replicas_tiered(store, r_shard, r_cslot, o_shard, o_slot,
         a = pad_bucket(len(si), (r_sh[si], 0), (r_cs[si], OOB),
                        minimum=store.bucket_min)
         v = store._vals_bucket(fresh, a[0].shape[0])
-        if resid is None:
-            with _GATE:
-                store.cache, store.delta = _install_cache_rows(
-                    store.cache, store.delta, *a, v)
-        else:
-            rv = store._vals_bucket(resid, a[0].shape[0])
-            with _GATE:
-                store.cache, store.delta = _install_cache_rows_resid(
-                    store.cache, store.delta, *a, v, rv)
+        rv = None if resid is None else \
+            store._vals_bucket(resid, a[0].shape[0])
+        store.cache, store.delta = store.port.install_cache_rows(
+            store.cache, store.delta, *a, v, resid=rv)
     if store.tier_hist is not None:
         store.tier_hist.observe(time.perf_counter() - t0)
 
@@ -369,8 +294,7 @@ def relocate_tiered(store, old_shard, old_slot, new_shard, new_slot,
         rows[has_rc] += d
         a = pad_bucket(int(has_rc.sum()), (rc_sh[has_rc], 0),
                        (rc_sl[has_rc], OOB), minimum=store.bucket_min)
-        with _GATE:
-            store.delta = _clear_rows(store.delta, *a)
+        store.delta = store.port.clear_rows(store.delta, *a)
     # free the old residency (value already extracted), land cold
     release_rows(store, old_sh[valid], old_sl[valid])
     dst_ok = (new_sl >= 0) & (new_sl != OOB)

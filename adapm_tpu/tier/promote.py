@@ -21,20 +21,13 @@ from __future__ import annotations
 
 from functools import partial
 
-import jax
 import numpy as np
 
 from ..core.store import OOB, pad_bucket
-from ..exec import dispatch_gate
 
-_GATE = dispatch_gate()  # sharded-dispatch serialization, docs/EXECUTOR.md
-
-
-@partial(jax.jit, donate_argnums=(0,))
-def _write_main_rows(main, sh, row, vals):
-    """Install host rows into the hot pool (promotion upload; padding
-    rows carry OOB and are dropped)."""
-    return main.at[sh, row].set(vals, mode="drop")
+# the promotion upload programs (_write_main_rows and its wire twins)
+# live on the DevicePort since ISSUE 14 (device/jaxport.py) — this
+# module stays device-API-free (adapm-lint APM008)
 
 
 def promote_rows(store, shard: int, slots: np.ndarray) -> int:
@@ -60,36 +53,32 @@ def promote_rows(store, shard: int, slots: np.ndarray) -> int:
     if mode == "fp32":
         v = store._vals_bucket(store.coldq.read(
             np.full(len(take), shard), take), b)
-        with _GATE:
-            store.main = _write_main_rows(store.main, a[0], a[1], v)
+        store.main = store.port.write_main_rows(store.main, a[0],
+                                                a[1], v)
     else:
-        # dequant-fused upload (ops/dequant.py): ship the WIRE rows —
-        # half/quarter the host->device bytes — and invert the format
-        # inside the donated scatter. Rows with a parked EF residual
-        # (few) get their full-precision value re-set exactly right
-        # after: the residual folds into the promote, so the hot row
-        # carries the true long-run sum (docs/MEMORY.md contract).
-        from ..ops import dequant
+        # dequant-fused upload (the port's wire ingest): ship the WIRE
+        # rows — half/quarter the host->device bytes — and invert the
+        # format inside the donated scatter. Rows with a parked EF
+        # residual (few) get their full-precision value re-set exactly
+        # right after: the residual folds into the promote, so the hot
+        # row carries the true long-run sum (docs/MEMORY.md contract).
         q, s, fix_pos, fix_vals = store.coldq.promote_wire(shard, take)
         qb = np.zeros((b, store.value_length), dtype=q.dtype)
         qb[: len(take)] = q
-        with _GATE:
-            if mode == "fp16":
-                store.main = dequant._write_main_rows_fp16(
-                    store.main, a[0], a[1], qb)
-            else:
-                sb = np.zeros(b, dtype=np.float32)
-                sb[: len(take)] = s
-                store.main = dequant._write_main_rows_int8(
-                    store.main, a[0], a[1], qb, sb)
+        sb = None
+        if mode != "fp16":
+            sb = np.zeros(b, dtype=np.float32)
+            sb[: len(take)] = s
+        store.main = store.port.write_main_rows_wire(
+            mode, store.main, a[0], a[1], qb, sb)
         if len(fix_pos):
             f = pad_bucket(len(fix_pos),
                            (np.full(len(fix_pos), shard, np.int32), 0),
                            (rows[fix_pos].astype(np.int32), OOB),
                            minimum=store.bucket_min)
             fv = store._vals_bucket(fix_vals, f[0].shape[0])
-            with _GATE:
-                store.main = _write_main_rows(store.main, f[0], f[1], fv)
+            store.main = store.port.write_main_rows(store.main, f[0],
+                                                    f[1], fv)
     res.dev_row[shard, take] = rows
     res.row_slot[shard, rows] = take
     res.epoch += 1

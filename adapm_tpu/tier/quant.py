@@ -34,7 +34,7 @@ entry, so the fp16-representable / int-grid cases cost zero residual
 bytes (the "exact" half of the contract).
 
 Host and device MUST dequantize identically: the jitted dequant-fused
-programs (ops/dequant.py, core/store.py) use the same IEEE f32 ops —
+programs (device/jaxport.py) use the same IEEE f32 ops —
 f16<->f32 converts are exact/RTNE on both, and `round` is
 half-to-even in both numpy and XLA — so a cold row reads the same bits
 through the fused device gather and the host bulk-read path.

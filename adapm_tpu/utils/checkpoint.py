@@ -32,27 +32,17 @@ import numpy as np
 FORMAT_VERSION = 3
 
 
-_launder_fn = None
-
-
 def _launder(x):
     """Bit-exact copy through a jitted XLA program (see restore_server:
     a transfer-produced buffer entering the donated chain intermittently
     segfaults this image's XLA CPU; one extra pool copy at restore
     frequency is free). jnp.copy, NOT `a + 0`: addition maps -0.0 to
     +0.0, which would break the exact state round-trip this module
-    promises. The jitted copy is cached so repeated restores share one
-    compiled executable per pool shape."""
-    global _launder_fn
-    import jax
-    import jax.numpy as jnp
-
-    from ..exec import dispatch_gate
-    if _launder_fn is None:
-        _launder_fn = jax.jit(lambda a: jnp.copy(a))
-    with dispatch_gate():  # sharded program: one enqueue order per
-        # device set (docs/EXECUTOR.md)
-        return _launder_fn(x)
+    promises. Lives on the DevicePort since ISSUE 14 (one compiled
+    executable per pool shape, shared process-wide; the port holds the
+    dispatch gate internally)."""
+    from ..device import default_port
+    return default_port().launder(x)
 
 
 def rank_path(path: str, rank: int) -> str:
@@ -122,7 +112,6 @@ def restore_server(server, path: str) -> None:
     """Restore state saved by save_server into a compatibly-constructed
     Server (same num_keys, value_lengths, shard count, pool geometry;
     multi-process: same process count — each rank reads its own shard)."""
-    import jax
     if server.fault is not None:
         # fires before any mutation: a failed restore leaves the live
         # server serving its current state (ISSUE 10)
@@ -198,15 +187,15 @@ def restore_server(server, path: str) -> None:
                     assert arr.shape == cur.shape, (
                         f"pool {name}_{cid} geometry mismatch: "
                         f"checkpoint {arr.shape} vs server {cur.shape}")
-                new = jax.device_put(arr, sh)
-                # route the restored pool through an XLA program before
-                # it re-enters the donated-buffer chain: this image's
-                # XLA CPU intermittently SEGFAULTS when a later donating
-                # program (e.g. the first post-restore sync_replicas)
-                # consumes a buffer produced directly by a host->device
-                # transfer (observed ~50% of test_checkpoint sessions,
-                # also on pre-r6 code); an XLA-produced buffer dodges it
-                setattr(st, name, _launder(new))
+                # install_pool routes the restored pool through an XLA
+                # program before it re-enters the donated-buffer chain:
+                # this image's XLA CPU intermittently SEGFAULTS when a
+                # later donating program (e.g. the first post-restore
+                # sync_replicas) consumes a buffer produced directly by
+                # a host->device transfer (observed ~50% of
+                # test_checkpoint sessions, also on pre-r6 code); an
+                # XLA-produced buffer dodges it
+                setattr(st, name, st.port.install_pool(arr, sh))
 
         # rebuild free lists from table occupancy
         for cid in range(len(server.stores)):

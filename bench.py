@@ -995,6 +995,103 @@ def bench_exec(E=40_000, d=32, B=1024, steps=60, warmup=20,
             "overlapped_vs_serialized_wall_ratio": round(ratio, 3)}
 
 
+def bench_episodic(E=40_000, d=16, B=512, steps=48, warmup=12,
+                   skew=16.0, hot_frac=0.25, episode_batches=8):
+    """Episodic-execution phase (ISSUE 14): wall time of a
+    BEYOND-HOT-CAPACITY fused-step workload (zipf keys over a
+    25%-capacity hot pool, so every batch carries cold rows) run
+    EPISODICALLY (device/episode.py: promotion + key staging of window
+    N+1 on the `episode` stream overlapping window N's step commits on
+    `episode_commit`) vs strictly SEQUENTIALLY (plain runner calls —
+    each step pays its forced promotion inline). One fixed batch
+    schedule is shared; the drain of the episode streams and the final
+    block are INSIDE both timed windows. The artifact records both
+    walls, the episodic/sequential ratio (the perf payload: < 1.0 =
+    prep genuinely overlapped compute), the episodic server's
+    exec.overlap_fraction, and the episode metrics section."""
+    import adapm_tpu
+    import jax
+    import jax.numpy as jnp
+    from adapm_tpu.config import SystemOptions
+    from adapm_tpu.device import EpisodicRunner
+    from adapm_tpu.ops import DeviceRoutedRunner
+
+    L = 2 * d
+    S = len(jax.devices())
+    rng = np.random.default_rng(0)
+
+    def batch():
+        return {
+            "a": (E * rng.random(B) ** skew).astype(np.int64)
+            .clip(0, E - 1),
+            "b": (E * rng.random(B) ** skew).astype(np.int64)
+            .clip(0, E - 1)}
+
+    sched = [batch() for _ in range(warmup + steps)]
+    init = np.random.default_rng(1).normal(size=(E, L)).astype(np.float32)
+    init[:, d:] = np.abs(init[:, d:]) + 1e-3  # AdaGrad acc columns
+    hot_rows = max(8, -(-int(E * hot_frac) // S))
+
+    def loss_fn(embs, aux):
+        return jnp.mean(jnp.sum(embs["a"] * embs["b"], axis=-1))
+
+    def run_config(episodic: bool):
+        srv = adapm_tpu.setup(E, L, opts=SystemOptions(
+            sync_max_per_sec=0, prefetch=False,
+            tier=True, tier_hot_rows=hot_rows,
+            episode_batches=episode_batches))
+        w = srv.make_worker(0)
+        slab = 50_000
+        for lo in range(0, E, slab):
+            hi = min(lo + slab, E)
+            w.set(np.arange(lo, hi), init[lo:hi])
+        runner = DeviceRoutedRunner(srv, loss_fn, {"a": 0, "b": 0},
+                                    {"a": d, "b": d}, shard=0, seed=3)
+        ep = EpisodicRunner(runner) if episodic else None
+        for b in sched[:warmup]:
+            runner(b, None, 1e-3)
+            srv.tier.maintain()
+        srv.block()
+        t0 = time.perf_counter()
+        if episodic:
+            losses = ep.run(sched[warmup:], lr=1e-3)
+            float(losses[-1])
+        else:
+            loss = None
+            for b in sched[warmup:]:
+                loss = runner(b, None, 1e-3)
+            float(loss)
+        srv.exec.drain("episode_commit", timeout=120)
+        srv.block()
+        dt = time.perf_counter() - t0
+        out = {"wall_s": round(dt, 4),
+               "steps_per_sec": round(steps / dt, 2),
+               "overlap_fraction":
+                   round(srv.exec.overlap_fraction(), 4)}
+        if episodic:
+            snap = srv.metrics_snapshot()
+            out["episode_metrics"] = snap["episode"]
+            out["device_metrics"] = snap["device"]
+        srv.shutdown()
+        return out
+
+    _progress(f"episodic phase: sequential baseline ({E} keys, B={B}, "
+              f"hot {int(hot_frac * 100)}%)")
+    seq = run_config(False)
+    _progress("episodic phase: double-buffered episodic run")
+    epi = run_config(True)
+    ratio = epi["wall_s"] / max(1e-9, seq["wall_s"])
+    _progress(f"episodic phase: episodic/sequential wall ratio "
+              f"{ratio:.3f}, overlap_fraction "
+              f"{epi['overlap_fraction']:.3f}")
+    return {"batches_per_episode": episode_batches,
+            "hot_rows_per_shard": hot_rows,
+            "episodic": epi,
+            "sequential": seq,
+            "overlap_fraction": epi["overlap_fraction"],
+            "episodic_vs_sequential_wall_ratio": round(ratio, 3)}
+
+
 def bench_w2v(V=100_000, d=128, B=8192, N=5, steps=40, warmup=4,
               scan_steps=1) -> float:
     """word2vec SGNS fused-step throughput (pairs/sec) with on-device
@@ -1325,6 +1422,18 @@ def _phase_exec():
     return out
 
 
+def _phase_episodic():
+    import jax
+    sz = {"E": 10_000, "B": 256, "steps": 32, "warmup": 8,
+          "episode_batches": 4} \
+        if os.environ.get("ADAPM_BENCH_SMALL") else {}
+    out = bench_episodic(**sz)
+    out["virtual_shards"] = len(jax.devices("cpu"))
+    if sz:
+        out["small_sizes"] = sz
+    return out
+
+
 def _phase_fault():
     import jax
     sz = {"E": 8_000} if os.environ.get("ADAPM_BENCH_SMALL") else {}
@@ -1366,6 +1475,7 @@ _PHASES = {"probe": _phase_probe, "kge": _phase_kge,
            "dedup": _phase_dedup, "pm": _phase_pm, "mgmt": _phase_mgmt,
            "compress": _phase_compress, "serve": _phase_serve,
            "tier": _phase_tier, "exec": _phase_exec,
+           "episodic": _phase_episodic,
            "fault": _phase_fault, "w2v": _phase_w2v,
            "cpu": _phase_cpu}
 
@@ -1373,8 +1483,8 @@ _PHASES = {"probe": _phase_probe, "kge": _phase_kge,
 # these; a wedged relay burns one wall once, then the driver degrades
 _TIMEOUTS = {"probe": 120, "kge": 1200, "prefetch": 1200, "scan": 900,
              "dedup": 900, "pm": 900, "mgmt": 900, "compress": 900,
-             "serve": 900, "tier": 900, "exec": 900, "fault": 900,
-             "w2v": 900, "cpu": 600}
+             "serve": 900, "tier": 900, "exec": 900, "episodic": 900,
+             "fault": 900, "w2v": 900, "cpu": 600}
 
 _CPU_ENV = {"JAX_PLATFORMS": "cpu", "ADAPM_PLATFORM": "cpu",
             "ADAPM_BENCH_SMALL": "1"}
@@ -1419,20 +1529,39 @@ def _ok(r: dict) -> bool:
 
 
 def main():
-    # 1) Probe the default backend with a hard timeout. A wedged TPU relay
-    # hangs jax.devices() forever (observed r4/r5); in that case every
-    # device phase reruns on the host CPU so the round still produces a
-    # parseable, honestly-labeled artifact.
-    probe = _run_phase("probe")
-    tpu_ok = _ok(probe) and probe.get("platform") not in ("cpu", None)
-    dev_env: dict | None = None if tpu_ok else dict(_CPU_ENV)
-    platform = probe.get("platform") if _ok(probe) else "cpu"
-    if not tpu_ok:
-        _progress("backend unavailable or cpu-only: device phases degrade "
-                  "to JAX_PLATFORMS=cpu")
-
     results: dict = {}
     transients: dict = {}
+    # 0) Setup-death probe (ISSUE 14 satellite; the bench r04 mode: the
+    # TPU path ABORTING at client construction, before any phase runs).
+    # xla_compat.probe_device_backend checks the default backend in a
+    # throwaway subprocess; a definitive setup death records the NAMED
+    # error and `backend: skipped` in the artifact instead of dying —
+    # the device phases then run honestly on the host CPU.
+    from xla_compat import probe_device_backend
+    verdict, detail = probe_device_backend()
+    if verdict is not True:
+        results["backend"] = "skipped"
+        results["backend_error"] = \
+            f"AcceleratorUnavailableError: {detail}"
+        _progress(f"backend skipped ({detail}); device phases degrade "
+                  f"to JAX_PLATFORMS=cpu")
+        probe = {"error": results["backend_error"]}
+        tpu_ok = False
+    else:
+        # 1) Probe the default backend IN-PHASE with a hard timeout. A
+        # wedged TPU relay hangs jax.devices() forever (observed
+        # r4/r5); in that case every device phase reruns on the host
+        # CPU so the round still produces a parseable, honestly-labeled
+        # artifact.
+        probe = _run_phase("probe")
+        tpu_ok = _ok(probe) and probe.get("platform") not in ("cpu", None)
+        results["backend"] = probe.get("platform", "cpu") if _ok(probe) \
+            else "skipped"
+    dev_env: dict | None = None if tpu_ok else dict(_CPU_ENV)
+    platform = probe.get("platform") if _ok(probe) else "cpu"
+    if not tpu_ok and "backend_error" not in results:
+        _progress("backend unavailable or cpu-only: device phases degrade "
+                  "to JAX_PLATFORMS=cpu")
     for name in ("kge", "prefetch", "scan", "dedup", "w2v"):
         r = _run_phase(name, dev_env)
         if not _ok(r) and dev_env is None:
@@ -1498,6 +1627,11 @@ def main():
     # configurations on the same backend, and the overlap being
     # measured is host prep vs device dispatch on this host
     results["exec"] = _run_phase("exec", pm_env)
+    # episodic-execution phase (ISSUE 14): host-CPU by design — the
+    # episodic-vs-sequential comparison needs both drivers on the same
+    # backend, and the overlap measured is host episode prep vs the
+    # previous window's device compute on this host
+    results["episodic"] = _run_phase("episodic", pm_env)
     # robustness phase (ISSUE 10): host-CPU by design — incremental
     # checkpoint bytes and recovery wall time are host serialization
     results["fault"] = _run_phase("fault", pm_env)

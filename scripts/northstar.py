@@ -24,7 +24,6 @@ from __future__ import annotations
 import json
 import sys
 import time
-from functools import partial
 
 import numpy as np
 
@@ -43,23 +42,45 @@ T0 = time.perf_counter()
 
 
 def bulk_device_init(store, emb_cols: int, scale: float, seed: int) -> None:
-    """Fill a store's whole main pool on device: normal(0, scale) embedding
+    """Fill a store's whole main table: normal(0, scale) embedding
     columns, 1e-6 optimizer-state columns. Slot assignment is irrelevant —
     every slot gets an i.i.d. row, so this equals a per-key host init in
-    distribution while skipping the host->HBM transfer entirely (a 4.6M x
-    512 table inits in milliseconds instead of minutes)."""
+    distribution.
+
+    Untiered: one device fill program (skips the host->HBM transfer
+    entirely — a 4.6M x 512 table inits in milliseconds instead of
+    minutes), constructed through the DevicePort like every other
+    program (ISSUE 14). Tiered (--tier): the authoritative table IS the
+    host cold store, so the init is a host fill — rows promote lazily
+    to the HBM hot pool as the workload touches them, which is the
+    point: the table no longer needs to fit on the chip."""
     import jax
     import jax.numpy as jnp
+
+    from adapm_tpu.device import default_port
+
+    if store.res is not None:
+        # tiered: fill the cold store host-side (slabbed generation; at
+        # full KGE scale this is the one place the host pays the table)
+        from adapm_tpu.tier.coldpath import install_main_full
+        S, M, L = store.main_shape_full
+        rng = np.random.default_rng(seed)
+        full = rng.standard_normal((S, M, L), dtype=np.float32)
+        full *= np.float32(scale)  # in place: a second full-size array
+        # here would transiently DOUBLE host RSS at KGE scale
+        full[:, :, emb_cols:] = 1e-6
+        install_main_full(store, full)
+        return
 
     S, M, L = store.main.shape
     slab = min(M, 262_144)
 
-    @partial(jax.jit, donate_argnums=0)
     def fill(main, key, lo):
         r = jax.random.normal(key, (S, slab, L), main.dtype) * scale
         r = r.at[:, :, emb_cols:].set(1e-6)
         return jax.lax.dynamic_update_slice(main, r, (0, lo, 0))
 
+    fill = default_port().compile(fill, donate_argnums=0)
     key = jax.random.PRNGKey(seed)
     lo = 0
     while lo < M:
@@ -68,6 +89,31 @@ def bulk_device_init(store, emb_cols: int, scale: float, seed: int) -> None:
         store.main = fill(store.main, sub, jnp.int32(min(lo, M - slab)))
         lo += slab
     store.block()
+
+
+# --tier (ISSUE 14 satellite): run the scale workloads on the TIERED
+# store. The KGE table then no longer needs --sys.main_over_alloc≈1 to
+# fit a chip: the authoritative table lives in the host cold store and
+# only TIER_HOT_FRAC of the keys (per shard) occupy HBM, promoted by
+# the intent windows the pm loop already declares — and every program
+# rides the DevicePort like the rest of the tree.
+TIER = False
+TIER_HOT_FRAC = 0.25
+
+
+def _sys_opts(num_keys: int, **kw):
+    from adapm_tpu.config import SystemOptions
+    if TIER:
+        import jax
+        S = len(jax.devices())
+        # no HBM squeeze under tier: main_slots beyond the hot pool are
+        # host rows, so the relocation-headroom default costs no HBM
+        kw.pop("main_over_alloc", None)
+        kw.update(tier=True,
+                  tier_hot_rows=max(8, -(-int(num_keys * TIER_HOT_FRAC)
+                                         // S)))
+    return SystemOptions(cache_slots_per_shard=1, sync_max_per_sec=0,
+                         **kw)
 
 
 def skewed(rng, n, size):
@@ -117,14 +163,14 @@ def pm_loop(srv, w, runner, batches, aux, lr, steps, warmup):
 def run_kge(E=4_600_000, R=822, d=128, B=4096, N=32, steps=16,
             train_triples=20_614_279, full_epoch=False, do_eval=False):
     import adapm_tpu
-    from adapm_tpu.config import SystemOptions
     from adapm_tpu.models import make_kge_loss
     from adapm_tpu.ops import DeviceRoutedRunner
 
     progress(f"kge: building server ({E + R} keys x {4 * d} f32 = "
-             f"{(E + R) * 4 * d * 4 / 2**30:.1f} GiB main pool)")
-    srv = adapm_tpu.setup(E + R, 4 * d, opts=SystemOptions(
-        cache_slots_per_shard=1, sync_max_per_sec=0, main_over_alloc=1.02))
+             f"{(E + R) * 4 * d * 4 / 2**30:.1f} GiB main table"
+             + (", tiered)" if TIER else " on device)"))
+    srv = adapm_tpu.setup(E + R, 4 * d,
+                          opts=_sys_opts(E + R, main_over_alloc=1.02))
     bulk_device_init(srv.stores[0], 2 * d, 0.1, seed=0)
     progress("kge: init done (device bulk init)")
     w = srv.make_worker(0)
@@ -222,13 +268,11 @@ def run_kge(E=4_600_000, R=822, d=128, B=4096, N=32, steps=16,
 
 def run_w2v(V=800_000, d=128, B=8192, N=5, steps=24):
     import adapm_tpu
-    from adapm_tpu.config import SystemOptions
     from adapm_tpu.models.sgns import build_alias_table, sgns_loss, syn1_key
     from adapm_tpu.ops import DeviceRoutedRunner
 
     progress(f"w2v: building server ({2 * V} keys x {2 * d} f32)")
-    srv = adapm_tpu.setup(2 * V, 2 * d, opts=SystemOptions(
-        cache_slots_per_shard=1, sync_max_per_sec=0))
+    srv = adapm_tpu.setup(2 * V, 2 * d, opts=_sys_opts(2 * V))
     bulk_device_init(srv.stores[0], d, 0.05, seed=1)
     w = srv.make_worker(0)
     counts = 1.0 / (np.arange(V) + 10.0)  # zipf corpus frequencies
@@ -302,8 +346,7 @@ def run_mf(users=162_541, movies=59_047, rank=128, B=16_384, steps=24,
 
     K = users + movies
     progress(f"mf: building server ({K} keys x {2 * rank} f32)")
-    srv = adapm_tpu.setup(K, 2 * rank, opts=SystemOptions(
-        cache_slots_per_shard=1, sync_max_per_sec=0))
+    srv = adapm_tpu.setup(K, 2 * rank, opts=_sys_opts(K))
     bulk_device_init(srv.stores[0], rank, 0.1, seed=2)
     w = srv.make_worker(0)
     runner = DeviceRoutedRunner(
@@ -324,9 +367,12 @@ def run_mf(users=162_541, movies=59_047, rank=128, B=16_384, steps=24,
 
 
 def main():
-    argv = [a for a in sys.argv[1:] if a not in ("--epoch", "--eval")]
+    global TIER
+    argv = [a for a in sys.argv[1:]
+            if a not in ("--epoch", "--eval", "--tier")]
     full_epoch = "--epoch" in sys.argv[1:]
     do_eval = "--eval" in sys.argv[1:]
+    TIER = "--tier" in sys.argv[1:]
     which = argv or ["kge", "w2v", "mf"]
     runs = {"kge": lambda: run_kge(full_epoch=full_epoch, do_eval=do_eval),
             "w2v": run_w2v, "w2v_app": run_w2v_app, "mf": run_mf}

@@ -42,6 +42,12 @@ python scripts/tier_residency_check.py
 # must keep up with the serialized single-stream fallback on a tiered
 # promotion-churn workload (median pairwise ratio; overlap_fraction > 0)
 python scripts/exec_overlap_check.py
+# episodic-execution guard (ISSUE 14): on a beyond-hot-capacity zipf
+# fused-step workload, the double-buffered episode/episode_commit
+# pipeline must keep up with plain sequential execution (median
+# pairwise ratio), record exec.overlap_fraction > 0 (prep genuinely
+# overlapped compute), and dispatch nothing while idle
+python scripts/episode_overlap_check.py
 # compression-plane guard (ISSUE 8): a randomized push/promote/demote/
 # sync storm with both features OFF must stay bit-identical to an
 # untiered fp32 shadow (the pre-PR pin), the fp16/int8 storms must keep

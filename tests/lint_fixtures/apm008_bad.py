@@ -1,0 +1,33 @@
+"""APM008 known-bad fixture: jax program-construction APIs outside
+adapm_tpu/device/ — every shape the rule must catch."""
+import functools
+
+import jax
+import jax.experimental.shard_map  # plain-import evasion form
+from jax.experimental.shard_map import shard_map  # import form
+
+
+@jax.jit  # decorator form
+def prog(x):
+    return x + 1
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))  # partial form
+def donated(x):
+    return x * 2
+
+
+def stage(arr, sharding):
+    return jax.device_put(arr, sharding)  # transfer form
+
+
+def build_collective(fn, mesh, spec):
+    return jax.jit(shard_map(fn, mesh=mesh, in_specs=spec,
+                             out_specs=spec))  # bare-name use
+
+
+def build_collective_chained(fn, mesh, spec):
+    # attribute-chain use of the plain import
+    return jax.experimental.shard_map.shard_map(fn, mesh=mesh,
+                                                in_specs=spec,
+                                                out_specs=spec)

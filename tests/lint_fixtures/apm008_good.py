@@ -1,0 +1,25 @@
+"""APM008 known-good fixture: device work reaches the accelerator
+through the DevicePort — no direct jax program-construction APIs."""
+import numpy as np
+
+from adapm_tpu.device import default_port
+
+
+def make_step(body):
+    # program construction through the port
+    return default_port().compile(body, donate_argnums=(0,))
+
+
+def stage(arr, sharding):
+    return default_port().put_replicated(np.asarray(arr), sharding)
+
+
+def build_collective(fn, mesh, spec):
+    return default_port().compile_collective(fn, mesh=mesh,
+                                             in_specs=spec,
+                                             out_specs=spec)
+
+
+def dispatch(store, a):
+    # data-plane dispatch through the store's port methods
+    return store.port.gather(store.main, store.cache, store.delta, *a)

@@ -363,3 +363,24 @@ def test_lint_lockorder_knob_round_trip_and_rejection():
     assert off.lint_lockorder is False
     with pytest.raises(SystemExit):  # argparse type=int rejection
         p.parse_args(["--sys.lint.lockorder", "maybe"])
+
+
+def test_episode_batches_knob_round_trip_and_rejection():
+    """--sys.episode.batches (ISSUE 14): parses into the option
+    EpisodicRunner defaults from, defaults to 8, and zero is rejected
+    by validate_serve at parse time (an episode must hold a batch)."""
+    import argparse
+
+    import pytest
+
+    from adapm_tpu.config import SystemOptions
+    p = argparse.ArgumentParser()
+    SystemOptions.add_arguments(p)
+    dflt = SystemOptions.from_args(p.parse_args([]))
+    assert dflt.episode_batches == 8
+    got = SystemOptions.from_args(p.parse_args(
+        ["--sys.episode.batches", "3"]))
+    assert got.episode_batches == 3
+    with pytest.raises(ValueError, match="episode.batches"):
+        SystemOptions.from_args(p.parse_args(
+            ["--sys.episode.batches", "0"]))

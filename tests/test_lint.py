@@ -26,7 +26,8 @@ import pytest
 import adapm_tpu
 from adapm_tpu.config import SystemOptions
 from adapm_tpu.lint import Analyzer, default_rules, lockorder
-from adapm_tpu.lint.rules import (DonationAfterDispatchRule,
+from adapm_tpu.lint.rules import (DeviceApiConfinementRule,
+                                  DonationAfterDispatchRule,
                                   GateCoverageRule, MetricCatalogRule,
                                   NoBlockingUnderLockRule,
                                   RawThreadBanRule,
@@ -45,6 +46,7 @@ _RULE_BY_ID = {
     "APM005": DonationAfterDispatchRule,
     "APM006": RevalidateBeforeEnqueueRule,
     "APM007": MetricCatalogRule,
+    "APM008": DeviceApiConfinementRule,
 }
 
 
@@ -195,6 +197,34 @@ def test_apm004_parallel_thread_suppressions_used():
             if "APM004" in s.rules}
     assert "adapm_tpu/parallel/collective.py" in used
     assert "adapm_tpu/parallel/control.py" in used
+
+
+def test_apm008_device_api_confined_to_port():
+    """The ISSUE 14 refactor contract: core/ops/tier/serve/fault/
+    parallel hold ZERO direct jax.jit/device_put/shard_map uses — the
+    device plane lives behind adapm_tpu/device/ — and the intentional
+    exceptions (model-math eval programs, Pallas kernels) carry USED
+    justified suppressions, never a widened allowlist."""
+    rep = _run_tree()
+    assert not [f for f in rep.findings if f.rule == "APM008"], \
+        "\n" + rep.to_text()
+    used = {s.path for s in rep.suppressions_used
+            if "APM008" in s.rules}
+    assert "adapm_tpu/models/kge.py" in used
+    assert "adapm_tpu/io/kge.py" in used
+    assert "adapm_tpu/ops/pallas_kernels.py" in used
+
+
+def test_apm008_no_jit_in_refactored_modules():
+    """The five refactored construction sites named by ISSUE 14 stay
+    port-routed: zero APM008 findings (no suppressions either) in
+    store/fused/dequant/promote/coldpath."""
+    paths = [os.path.join(ROOT, "adapm_tpu", *p) for p in (
+        ("core", "store.py"), ("ops", "fused.py"),
+        ("tier", "promote.py"), ("tier", "coldpath.py"))]
+    rep = _analyze(paths, rules=[DeviceApiConfinementRule()])
+    assert not rep.findings, [f.format() for f in rep.findings]
+    assert not rep.suppressions_used
 
 
 def test_apm007_catalog_in_sync():

@@ -110,7 +110,8 @@ def test_device_and_host_wire_transforms_agree(rng):
     the int8 row's f16-rounded scale clips the same way)."""
     import jax.numpy as jnp
 
-    from adapm_tpu.core.store import OOB, _sync_replicas_compressed
+    from adapm_tpu.core.store import OOB
+    from adapm_tpu.device.jaxport import _sync_replicas_compressed
     n, vlen = 8, L
     d = (rng.normal(size=(n, vlen)) * [[0.01], [0.1], [1], [10], [100],
                                        [1000], [0.001], [1e9]]

@@ -97,6 +97,67 @@ def filter_xla_flags(flags: Sequence[str]) -> List[str]:
     return safe + kept
 
 
+class AcceleratorUnavailableError(RuntimeError):
+    """An accelerator backend cannot be used in this environment —
+    NAMED (ISSUE 14 satellite). The bench r04 death mode was the TPU
+    path dying AT SETUP (client construction aborts / hangs before the
+    first program); callers that see this error skip the backend and
+    record it (`bench.py` writes `backend: skipped`) instead of taking
+    the whole run down or silently degrading."""
+
+
+def probe_device_backend(platform=None, timeout: float = 180.0):
+    """Can `platform` (None = the environment's default backend)
+    initialize and enumerate devices? Probed in a throwaway subprocess
+    — an unusable backend often ABORTS or wedges client construction,
+    which no in-process try/except survives (the filter_xla_flags
+    lesson, applied to backends).
+
+    Returns (verdict, detail):
+      True,  "tpu x4"      — usable; detail names platform + count
+      False, "...rc=134.." — definitively unusable (died at setup)
+      None,  "...timeout"  — inconclusive (wedged relay / loaded host);
+                             treat as unusable for THIS run, but do not
+                             record it as a permanent verdict.
+    """
+    env = dict(os.environ)
+    if platform:
+        env["JAX_PLATFORMS"] = platform
+        env["ADAPM_PLATFORM"] = platform
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; ds = jax.devices(); "
+             "print(ds[0].platform, len(ds))"],
+            env=env, capture_output=True, timeout=timeout, text=True)
+    except subprocess.TimeoutExpired:
+        return None, (f"backend probe timed out after {timeout:.0f}s "
+                      f"(wedged relay / loaded host)")
+    except Exception as e:  # pragma: no cover - spawn failure
+        return None, f"backend probe failed to spawn: {e}"
+    if r.returncode != 0:
+        tail = " | ".join((r.stderr or "").strip().splitlines()[-3:])
+        return False, (f"backend died at setup (rc={r.returncode}): "
+                       f"{tail or 'no stderr'}")
+    parts = r.stdout.split()
+    detail = f"{parts[0]} x{parts[1]}" if len(parts) >= 2 else "ok"
+    return True, detail
+
+
+def require_device_backend(platform=None, timeout: float = 180.0) -> str:
+    """Raise AcceleratorUnavailableError unless `platform` probes
+    usable; returns the probe detail on success. The setup-death guard
+    for scripts that would otherwise die mid-construction (the bench
+    r04 mode)."""
+    verdict, detail = probe_device_backend(platform, timeout=timeout)
+    if verdict is not True:
+        raise AcceleratorUnavailableError(
+            f"accelerator backend "
+            f"{platform or os.environ.get('JAX_PLATFORMS', 'default')!r}"
+            f" is unusable here: {detail}")
+    return detail
+
+
 def mesh_flags(devices: int) -> str:
     """The harness's XLA_FLAGS value for an N-virtual-device CPU mesh:
     the device-count flag plus — only when the installed jaxlib knows

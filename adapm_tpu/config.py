@@ -209,6 +209,20 @@ class SystemOptions:
     # flight trace output path
     # (default: <stats_out or cwd>/flight.<rank>.trace.json)
     trace_flight_out: Optional[str] = None
+    # workload trace capture (ISSUE 15; obs/wtrace.py, docs/REPLAY.md):
+    # record the semantic op stream — pull/push/set key batches, intent
+    # windows, clock advances, serve lookups with tenant/priority/
+    # deadline, PrepareSample/PullSample, and relocation/sync/promotion
+    # decisions as they landed — into a versioned, checksummed .wtrace
+    # file at this path, replayable offline by adapm_tpu/replay/.
+    # Default off (None): Server.wtrace is None, every instrumented
+    # site pays one `is None` check, zero wtrace.* registry names (the
+    # r7 skip-wrapper discipline; scripts/metrics_overhead_check.py).
+    trace_workload: Optional[str] = None
+    # per-event exact-key budget: batches up to this record their exact
+    # keys; larger batches record an evenly-strided sample + the true
+    # count, loudly (wtrace.sampled_batches_total)
+    trace_workload_keys: int = 4096
 
     # -- online serving plane (sys.serve.*; adapm_tpu/serve,
     #    docs/SERVING.md). Knob ranges are validated by validate_serve()
@@ -394,6 +408,15 @@ class SystemOptions:
                 f"(got {self.serve_replica_refresh_ms}): a zero "
                 f"refresh throttle would let every snapshot miss queue "
                 f"an immediate refresh program")
+        if self.trace_workload_keys < 1:
+            raise ValueError(
+                f"--sys.trace.workload_keys must be >= 1 "
+                f"(got {self.trace_workload_keys}): a zero key budget "
+                f"would record no keys at all — an unreplayable trace")
+        if self.trace_workload is not None and not self.trace_workload:
+            raise ValueError(
+                "--sys.trace.workload needs a non-empty path for the "
+                ".wtrace file (omit the flag to disable capture)")
         if self.fault_spec:
             from .fault.inject import parse_fault_spec
             parse_fault_spec(self.fault_spec)  # raises ValueError on a
@@ -520,6 +543,11 @@ class SystemOptions:
                        type=int, default=0)
         g.add_argument("--sys.trace.flight_out",
                        dest="sys_trace_flight_out", default=None)
+        g.add_argument("--sys.trace.workload",
+                       dest="sys_trace_workload", default=None)
+        g.add_argument("--sys.trace.workload_keys",
+                       dest="sys_trace_workload_keys", type=int,
+                       default=4096)
         g.add_argument("--sys.serve.max_batch", dest="sys_serve_max_batch",
                        type=int, default=64)
         g.add_argument("--sys.serve.max_wait_us",
@@ -617,6 +645,8 @@ class SystemOptions:
             crash_dumps=bool(args.sys_crash_dumps),
             trace_flight=bool(args.sys_trace_flight),
             trace_flight_out=args.sys_trace_flight_out,
+            trace_workload=args.sys_trace_workload,
+            trace_workload_keys=args.sys_trace_workload_keys,
             serve_max_batch=args.sys_serve_max_batch,
             serve_max_wait_us=args.sys_serve_max_wait_us,
             serve_queue=args.sys_serve_queue,

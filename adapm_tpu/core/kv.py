@@ -140,6 +140,22 @@ class Server:
         if self.opts.trace_flight:
             from ..obs.flight import FlightTracer
             self.flight = FlightTracer(registry=self.obs, rank=self.pid)
+        # workload trace capture (ISSUE 15 tentpole; obs/wtrace.py,
+        # docs/REPLAY.md): the semantic op stream recorded to a
+        # versioned, checksummed .wtrace file for the offline replay
+        # engine (adapm_tpu/replay). Default off — when None every
+        # instrumented site pays one `is None` check (the r7 skip-
+        # wrapper discipline) and the registry holds zero wtrace.*
+        # names.
+        self.wtrace = None
+        if self.opts.trace_workload:
+            from ..obs.wtrace import WorkloadTraceRecorder
+            self.wtrace = WorkloadTraceRecorder(
+                self, self.opts.trace_workload,
+                key_budget=self.opts.trace_workload_keys)
+        # populated by a ReplayEngine that drove this server (the
+        # snapshot's always-present `replay` section; schema v11)
+        self.replay_stats: Optional[Dict] = None
         # executor flight-recorder ring (rides --sys.crash_dumps): the
         # last K executor programs per stream, mirrored into a ring
         # file, so a hard abort's post-mortem says what was in flight.
@@ -1128,6 +1144,13 @@ class Server:
             with self._lock:
                 self.sync.replica_add(created, dest)
             self.sync.stats.add(replicas_created=len(created))
+        wt = self.wtrace
+        if wt is not None and (n_moved or len(demoted)):
+            # relocation decision as it landed (ISSUE 15): moves plus
+            # the pool-full demotions-to-replication — observational,
+            # replay lets the candidate policy re-decide
+            wt.record_decision("reloc", n_moved, dest=int(dest),
+                               demoted=int(len(demoted)))
         return n_moved
 
     # -- lifecycle -----------------------------------------------------------
@@ -1425,6 +1448,10 @@ class Server:
         self.write_stats()
         self.write_trace()
         self.write_flight_trace()
+        if self.wtrace is not None:
+            # final flush + seal AFTER every producer is stopped: the
+            # .wtrace on disk is the complete recorded stream
+            self.wtrace.close()
         if self.spans is not None:
             self.spans.close()
         if self.flight_recorder is not None:
@@ -1508,7 +1535,8 @@ class Server:
     _SNAPSHOT_SECTIONS = ("kv", "prefetch", "plan_cache", "staging",
                           "sync", "pm", "collective", "fused", "spans",
                           "serve", "tier", "exec", "flight", "slo",
-                          "fault", "ckpt", "device", "episode")
+                          "fault", "ckpt", "device", "episode",
+                          "wtrace", "replay")
 
     def metrics_snapshot(self, drain_device: bool = True) -> Dict:
         """One structured, JSON-serializable telemetry dict for this
@@ -1606,8 +1634,19 @@ class Server:
         wire-ingest-row totals (adapm_tpu/device). `episode` —
         episodic-execution counters and prep/commit wall histograms
         (device/episode.py EpisodicRunner); `{}` until a runner is
-        constructed."""
-        out: Dict = {"schema_version": 10,
+        constructed.
+
+        schema_version 11 (PR 13): always-present `wtrace` and
+        `replay` sections (ISSUE 15). `wtrace` — workload trace
+        capture (obs/wtrace.py, `--sys.trace.workload`): event /
+        dropped / sampled-batch counters, bytes written, the trace
+        path and buffered-event count; `{}` when capture is off (no
+        recorder object, zero wtrace.* names). `replay` — populated
+        on a server DRIVEN by the offline replay engine
+        (adapm_tpu/replay): events replayed/skipped, the replay seed
+        and logical speed, and the reads digest the determinism
+        contract pins; `{}` everywhere else."""
+        out: Dict = {"schema_version": 11,
                      "metrics_enabled": bool(self.obs.enabled)}
         for s in self._SNAPSHOT_SECTIONS:
             out[s] = {}
@@ -1663,6 +1702,10 @@ class Server:
             out["flight"].update(self.flight.stats())
         if self.flight_recorder is not None:
             out["flight"]["recorder"] = self.flight_recorder.summary()
+        if self.wtrace is not None:
+            out["wtrace"].update(self.wtrace.stats())
+        if self.replay_stats is not None:
+            out["replay"].update(self.replay_stats)
         if self._serve_plane is not None and \
                 self._serve_plane.slo is not None:
             out["slo"].update(self._serve_plane.slo.report())
@@ -1718,6 +1761,11 @@ class Server:
         self.block()
 
     def quiesce(self) -> None:
+        wt = self.wtrace
+        if wt is not None:
+            # recorded at entry so replay re-drives the quiesce at the
+            # same point in the op stream (docs/REPLAY.md)
+            wt.record_quiesce()
         with self._round_lock:
             self.sync.quiesce()
 
@@ -1889,6 +1937,9 @@ class Worker:
     def _pull_op(self, keys, out: Optional[np.ndarray]) -> int:
         keys = self._keys(keys)
         srv = self.server
+        wt = srv.wtrace  # bind-once, test-once (APM003 skip-wrapper)
+        if wt is not None:
+            wt.record_kv("pull", self.worker_id, self._clock, keys)
         if srv.prefetch is not None:
             st = srv.prefetch.take_staged(self, keys)
             if st is not None:
@@ -1971,6 +2022,9 @@ class Worker:
         keys = self._keys(keys)
         vals = np.asarray(vals, dtype=np.float32)
         srv = self.server
+        wt = srv.wtrace
+        if wt is not None:
+            wt.record_kv("push", self.worker_id, self._clock, keys)
         probe = None
         fl = srv.flight  # bind-once, test-once (APM003 skip-wrapper)
         if fl is not None:
@@ -2029,6 +2083,9 @@ class Worker:
         keys = self._keys(keys)
         vals = np.asarray(vals, dtype=np.float32)
         srv = self.server
+        wt = srv.wtrace
+        if wt is not None:
+            wt.record_kv("set", self.worker_id, self._clock, keys)
         after = self._live_write_futs() if srv.glob is not None else ()
         # Set may invalidate (consume the delta of) cross-process replicas;
         # that must not interleave with an in-flight sync round's extracted
@@ -2105,14 +2162,21 @@ class Worker:
         a pre-gathered staged buffer."""
         keys = np.unique(self._keys(keys))
         end = start if end is None else end
-        self._intent_queue.push(keys, int(start), int(end))
         srv = self.server
+        wt = srv.wtrace
+        if wt is not None:
+            wt.record_intent(self.worker_id, self._clock, keys,
+                             int(start), int(end))
+        self._intent_queue.push(keys, int(start), int(end))
         if srv.prefetch is not None:
             srv.prefetch.on_intent(self, keys, int(start), int(end))
 
     def advance_clock(self) -> int:
         self._clock += 1
         self.server._clocks[self.worker_id] = self._clock
+        wt = self.server.wtrace
+        if wt is not None:
+            wt.record_clock(self.worker_id, self._clock)
         return self._clock
 
     @property
@@ -2127,11 +2191,20 @@ class Worker:
         worker will sample `n` keys around clock [start, end]."""
         start = self._clock if start is None else start
         end = start if end is None else end
-        return self.server.sampling.prepare(self, n, int(start), int(end))
+        h = self.server.sampling.prepare(self, n, int(start), int(end))
+        wt = self.server.wtrace
+        if wt is not None:
+            wt.record_sample("prep_sample", self.worker_id, self._clock,
+                             h, n, int(start), int(end))
+        return h
 
     def pull_sample(self, handle: int, n: Optional[int] = None):
         """Draw n keys (default: all prepared) from sampling handle; returns
         (keys, values[B, L])."""
+        wt = self.server.wtrace
+        if wt is not None:
+            wt.record_sample("pull_sample", self.worker_id, self._clock,
+                             handle, n)
         return self.server.sampling.pull(self, handle, n)
 
     def pull_sample_keys(self, handle: int, n: Optional[int] = None):
@@ -2140,6 +2213,10 @@ class Worker:
         return self.server.sampling.pull_keys(self, handle, n)
 
     def finish_sample(self, handle: int) -> None:
+        wt = self.server.wtrace
+        if wt is not None:
+            wt.record_sample("finish_sample", self.worker_id,
+                             self._clock, handle, None)
         self.server.sampling.finish(self, handle)
 
     # -- API: lifecycle -------------------------------------------------------

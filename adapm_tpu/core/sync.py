@@ -649,6 +649,15 @@ class SyncManager:
             self._last_round_bytes = \
                 sum(st.sync_bytes_shipped
                     for st in self.server.stores) - bytes_before
+            wt = self.server.wtrace
+            if wt is not None:
+                # the round as it LANDED (ISSUE 15): replay re-drives
+                # these events instead of running a timer-driven
+                # background loop — rounds happen where the workload
+                # put them, not where a wall clock did
+                wt.record_sync(forced=force_intents,
+                               all_channels=all_channels,
+                               bytes_shipped=self._last_round_bytes)
 
     def _sync_all_channels(self) -> None:
         """All channels' rounds. Multi-process, >1 channel: issued

@@ -90,15 +90,9 @@ class CheckpointChainError(RuntimeError):
     during verification, BEFORE any server mutation."""
 
 
-def _write_atomic(path: str, data: bytes) -> None:
-    """tmp + fsync + rename: a crash mid-write leaves the previous
-    file (or nothing), never a torn one."""
-    tmp = path + ".tmp"
-    with open(tmp, "wb") as f:
-        f.write(data)
-        f.flush()
-        os.fsync(f.fileno())
-    os.replace(tmp, path)
+from ..utils import write_atomic as _write_atomic  # noqa: E402 — the
+# shared tmp+fsync+rename discipline (adapm_tpu/utils; also used by the
+# workload-trace recorder and the replay artifact writer)
 
 
 def _npz_bytes(arrs: Dict[str, np.ndarray]) -> bytes:

@@ -436,7 +436,13 @@ class FlightRecorder:
     def record(self, stream: str, label: str,
                coalesce_key: Optional[str], wait_s: float, run_s: float,
                failed: bool = False) -> None:
-        entry = (time.time(), label, coalesce_key, wait_s, run_s, failed)
+        # BOTH clock domains (ISSUE 15 satellite): span/flight slices
+        # are monotonic, so a ring stamped with wall time alone skews
+        # against them across NTP steps when timelines are merged —
+        # record wall (for humans/post-mortems) AND monotonic (for
+        # ordering/replay alignment)
+        entry = (time.time(), time.monotonic(), label, coalesce_key,
+                 wait_s, run_s, failed)
         with self._lock:
             dq = self._rings.get(stream)
             if dq is None:
@@ -460,18 +466,22 @@ class FlightRecorder:
 
     def tail(self, stream: Optional[str] = None) -> List[Dict]:
         """Most-recent-last entries of one stream's ring (or all
-        streams merged by wall time)."""
+        streams merged by the MONOTONIC stamp — wall time can step
+        backwards under NTP; each entry carries both as `t`/`t_mono`)."""
         if stream is not None:
             rings = [(stream, self._rings.get(stream, ()))]
         else:
             rings = list(self._rings.items())
         out = []
         for name, dq in rings:
-            for (t, label, ck, wait_s, run_s, failed) in list(dq):
-                out.append({"t": t, "stream": name, "label": label,
-                            "coalesce_key": ck, "wait_s": wait_s,
-                            "run_s": run_s, "failed": failed})
-        out.sort(key=lambda e: e["t"])
+            for (t, mono, label, ck, wait_s, run_s, failed) in list(dq):
+                out.append({"t": t, "t_mono": mono, "stream": name,
+                            "label": label, "coalesce_key": ck,
+                            "wait_s": wait_s, "run_s": run_s,
+                            "failed": failed})
+        # merge by the MONOTONIC stamp: wall time can step backwards
+        # under NTP, and a merged timeline must never reorder
+        out.sort(key=lambda e: e["t_mono"])
         return out
 
     def summary(self) -> Dict:

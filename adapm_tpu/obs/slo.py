@@ -81,7 +81,8 @@ class SLOController:
         # validate_serve guarantees metrics are on when slo_ms is set)
         self._prev_snap: Optional[Dict] = None
         self._closed = False
-        # bounded adjustment log: (wall_time, old_us, new_us, p99_ms)
+        # bounded adjustment log:
+        # (wall_time, mono_time, old_us, new_us, p99_ms)
         self.adjustments: "collections.deque" = collections.deque(
             maxlen=256)
         # the very first move, kept past the deque bound: the
@@ -170,7 +171,10 @@ class SLOController:
         self.batcher.max_wait_us = new
         self.c_adjust.inc()
         self.g_wait.set(float(new))
-        move = (time.time(), cur, new, p99 * 1e3)
+        # BOTH clock domains (ISSUE 15 satellite): the serve latency
+        # slices this log is read against are monotonic — a wall-only
+        # stamp skews the merged timeline across NTP steps
+        move = (time.time(), time.monotonic(), cur, new, p99 * 1e3)
         if self.first_adjustment is None:
             self.first_adjustment = move
         self.adjustments.append(move)
@@ -181,14 +185,14 @@ class SLOController:
         """JSON-safe summary for `metrics_snapshot()["slo"]` and the
         bench artifact."""
         last: List = [
-            {"t": round(t, 3), "old_us": o, "new_us": n,
-             "p99_ms": round(p, 3)}
-            for (t, o, n, p) in list(self.adjustments)[-8:]]
+            {"t": round(t, 3), "t_mono": round(tm, 6), "old_us": o,
+             "new_us": n, "p99_ms": round(p, 3)}
+            for (t, tm, o, n, p) in list(self.adjustments)[-8:]]
         first = None
         if self.first_adjustment is not None:
-            t, o, n, p = self.first_adjustment
-            first = {"t": round(t, 3), "old_us": o, "new_us": n,
-                     "p99_ms": round(p, 3)}
+            t, tm, o, n, p = self.first_adjustment
+            first = {"t": round(t, 3), "t_mono": round(tm, 6),
+                     "old_us": o, "new_us": n, "p99_ms": round(p, 3)}
         return {"active": True,
                 "target_ms": round(self.target_s * 1e3, 3),
                 "wait_us": int(self.batcher.max_wait_us),

@@ -1,0 +1,443 @@
+"""Workload trace capture: the semantic op stream, recorded once,
+replayable forever (ISSUE 15 tentpole, capture half; ROADMAP item 3).
+
+Every policy in this system — tier scoring, relocation-vs-replication,
+the SLO control law, admission windows — is a hand-tuned constant that
+can only be evaluated by running the live system. The
+`WorkloadTraceRecorder` (`--sys.trace.workload PATH`, default **off**)
+fixes the other half of that equation: it records the workload's
+SEMANTIC op stream — pull/push/set key batches, intent windows, clock
+advances, serve lookups with tenant/priority/deadline,
+PrepareSample/PullSample, and the relocation/sync/promotion decisions
+as they landed — into a versioned, checksummed `.wtrace` file that the
+offline replay engine (`adapm_tpu/replay/`) re-drives deterministically
+against a fresh server under candidate knob overrides. Capture once,
+score policies forever — the COGNATE transferred-trace methodology
+(PAPERS.md) applied to parameter management; the DLRM embedding-bag
+access shapes (multi-class gathers, zipf skew, intent windows) are
+exactly what the key-batch events preserve.
+
+Disciplines (all inherited from earlier planes):
+
+  - **Default off at the r7 skip-wrapper cost.** With no
+    `--sys.trace.workload`, `Server.wtrace is None`, every instrumented
+    site pays one `is None` check, and the registry holds zero
+    `wtrace.*` names (pinned by `scripts/metrics_overhead_check.py` and
+    adapm-lint APM003 — `wtrace` is an OPTIONAL_HANDLE).
+  - **Lossless-or-loudly-sampled.** Key batches up to
+    `--sys.trace.workload_keys` record their EXACT keys; larger batches
+    record an evenly-strided sample plus the true count and a
+    `sampled` marker (`wtrace.sampled_batches_total` counts them —
+    never a silent truncation). The event buffer itself is bounded
+    (`max_events`); events beyond it are counted in
+    `wtrace.dropped_total` and logged once, never silently lost.
+  - **Both clock domains, always.** Every event carries the logical
+    clock (the issuing worker's, or the server-wide max for
+    server-side events), `wall` (`time.time()`) AND `mono`
+    (`time.monotonic()`) — merged timelines and replay alignment must
+    not skew across NTP steps (the ISSUE 15 clock-domain satellite
+    applies the same rule to the flight recorder and SLO move log).
+  - **Atomic, versioned, checksummed file.** `flush()` writes a
+    one-line JSON header (format name, version, body sha256, body
+    byte count) followed by the JSON body via the r15 checkpoint
+    discipline (tmp + fsync + rename). `load_wtrace` verifies format,
+    version, length, and digest BEFORE returning anything — a
+    truncated or flipped file raises the named `WorkloadTraceError`,
+    never a half-parsed trace (and therefore never a half-replayed
+    server).
+
+Event kinds (the `kind` field):
+
+  `pull` / `push` / `set`   worker data-plane ops (wid, clock, keys)
+  `intent`                  intent window (wid, clock, keys, start, end)
+  `clock`                   advance_clock (wid, new clock)
+  `serve`                   ServeSession.lookup (keys, tenant,
+                            priority, deadline_ms)
+  `prep_sample` / `pull_sample` / `finish_sample`
+                            managed sampling (wid, handle, n, window)
+  `sync`                    a completed sync round (forced,
+                            all_channels, wire bytes) — replay
+                            re-drives these instead of running a
+                            timer-driven background loop
+  `quiesce`                 full quiesce points
+  `reloc` / `promote`       management decisions as they landed
+                            (observational: replay lets the candidate
+                            policy re-decide; the recorded stream is
+                            the baseline to compare against)
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+import time
+import zlib
+from typing import Dict, List, Optional
+
+import numpy as np
+
+WTRACE_FORMAT = "adapm-wtrace"
+WTRACE_VERSION = 1
+
+# hard bounds on the buffered stream (loud drop counter beyond either);
+# the per-event key budget is the --sys.trace.workload_keys knob. The
+# byte bound is an APPROXIMATE host-memory guard: an event-count bound
+# alone would let 1M max-budget key batches grow to tens of GB resident
+DEFAULT_MAX_EVENTS = 1_000_000
+DEFAULT_MAX_BYTES = 256 * 1024 * 1024
+
+
+class WorkloadTraceError(RuntimeError):
+    """The `.wtrace` file is unreadable: wrong format/version, truncated
+    body, checksum mismatch, or malformed JSON. Raised by `load_wtrace`
+    during verification, BEFORE any replay server exists — a corrupt
+    trace can never half-drive a replay (fault/ckpt.py discipline)."""
+
+
+from ..utils import write_atomic as _write_atomic  # noqa: E402 — the
+# shared tmp+fsync+rename discipline (adapm_tpu/utils): a crash
+# mid-flush leaves the previous file (or nothing), never a torn trace
+
+
+class WorkloadTraceRecorder:
+    """One per Server when `--sys.trace.workload` names a path; owned
+    and closed by the server (shutdown step 9, after every producer is
+    stopped). Thread-safe: client threads, executor workers, and the
+    sync round all record concurrently under one small lock (append +
+    counter bumps only — never a device wait, never the server lock)."""
+
+    def __init__(self, server, path: str, key_budget: int = 4096,
+                 max_events: int = DEFAULT_MAX_EVENTS,
+                 max_bytes: int = DEFAULT_MAX_BYTES):
+        from .metrics import Counter, Gauge
+        if not path:
+            raise ValueError("workload trace capture needs a path "
+                             "(--sys.trace.workload)")
+        self._server = server
+        self.path = path
+        self.key_budget = max(1, int(key_budget))
+        self.max_events = int(max_events)
+        self.max_bytes = int(max_bytes)
+        self._lock = threading.Lock()
+        # serializes snapshot->serialize->rename so a mid-run flush
+        # racing close() cannot publish an older snapshot over a newer
+        # one (the torn-file half of that race is already gone: the
+        # shared write_atomic uses writer-unique tmp names)
+        self._flush_lock = threading.Lock()
+        self._events: List[Dict] = []
+        self._approx_bytes = 0
+        self._seq = 0
+        self._closed = False
+        self._flushes = 0
+        self._warned_drop = False
+        self.wall_t0 = time.time()
+        self.mono_t0 = time.monotonic()
+        reg = server.obs
+        use_reg = reg is not None and reg.enabled
+        if use_reg:
+            self.c_events = reg.counter("wtrace.events_total")
+            self.c_dropped = reg.counter("wtrace.dropped_total")
+            self.c_sampled = reg.counter("wtrace.sampled_batches_total")
+            self.g_bytes = reg.gauge("wtrace.bytes_written")
+        else:  # capture works with --sys.metrics 0 (standalone tallies)
+            self.c_events = Counter("wtrace.events_total")
+            self.c_dropped = Counter("wtrace.dropped_total")
+            self.c_sampled = Counter("wtrace.sampled_batches_total")
+            self.g_bytes = Gauge("wtrace.bytes_written")
+
+    # -- recording -----------------------------------------------------------
+
+    def _server_clock(self) -> int:
+        c = self._server._clocks
+        return int(c.max()) if len(c) else 0
+
+    def _key_fields(self, keys: np.ndarray) -> Dict:
+        """Exact keys up to the budget; an evenly-strided sample plus
+        the true count beyond it (sampled-with-counts, counted loudly —
+        never a silent truncation)."""
+        n = len(keys)
+        out: Dict = {"n": int(n),
+                     "fp": int(zlib.crc32(np.ascontiguousarray(
+                         keys, dtype=np.int64).tobytes()))}
+        if n <= self.key_budget:
+            out["keys"] = [int(k) for k in keys]
+        else:
+            stride = -(-n // self.key_budget)  # ceil: <= budget samples
+            out["sample"] = [int(k) for k in keys[::stride]]
+            out["sampled"] = True
+            self.c_sampled.inc()
+        return out
+
+    def _append(self, ev: Dict) -> None:
+        # approximate resident cost: fixed stamps + the boxed key ints
+        # (8 bytes of JSON/int each is the right order of magnitude)
+        cost = 96 + 8 * (len(ev.get("keys", ())) +
+                         len(ev.get("sample", ())))
+        with self._lock:
+            if self._closed:
+                return
+            if len(self._events) >= self.max_events or \
+                    self._approx_bytes + cost > self.max_bytes:
+                self.c_dropped.inc()
+                if not self._warned_drop:
+                    self._warned_drop = True
+                    from ..utils import alog
+                    alog(f"[wtrace] event buffer full "
+                         f"({len(self._events)} events, "
+                         f"~{self._approx_bytes >> 20} MiB); further "
+                         f"events are DROPPED (counted in "
+                         f"wtrace.dropped_total) — the captured trace "
+                         f"is a loud prefix, not a silent lie")
+                return
+            ev["seq"] = self._seq
+            self._seq += 1
+            self._events.append(ev)
+            self._approx_bytes += cost
+        self.c_events.inc()
+
+    def _base(self, kind: str, clock: int,
+              wid: Optional[int] = None) -> Dict:
+        ev: Dict = {"kind": kind, "clock": int(clock),
+                    "wall": time.time(), "mono": time.monotonic()}
+        if wid is not None:
+            ev["wid"] = int(wid)
+        return ev
+
+    def record_kv(self, op: str, wid: int, clock: int,
+                  keys: np.ndarray) -> None:
+        """A worker data-plane op: op in {"pull", "push", "set"}."""
+        ev = self._base(op, clock, wid)
+        ev.update(self._key_fields(keys))
+        self._append(ev)
+
+    def record_intent(self, wid: int, clock: int, keys: np.ndarray,
+                      start: int, end: int) -> None:
+        ev = self._base("intent", clock, wid)
+        ev.update(self._key_fields(keys))
+        ev["start"] = int(start)
+        ev["end"] = min(int(end), 2**62)  # CLOCK_MAX stays JSON-safe
+        self._append(ev)
+
+    def record_clock(self, wid: int, clock: int) -> None:
+        self._append(self._base("clock", clock, wid))
+
+    def record_serve(self, keys: np.ndarray, tenant: Optional[str],
+                     priority: int, deadline_ms: float) -> None:
+        ev = self._base("serve", self._server_clock())
+        ev.update(self._key_fields(keys))
+        ev["tenant"] = tenant
+        ev["priority"] = int(priority)
+        ev["deadline_ms"] = float(deadline_ms or 0.0)
+        self._append(ev)
+
+    def record_sample(self, op: str, wid: int, clock: int, handle: int,
+                      n: Optional[int], start: Optional[int] = None,
+                      end: Optional[int] = None) -> None:
+        """Managed-sampling lifecycle: op in {"prep_sample",
+        "pull_sample", "finish_sample"}."""
+        ev = self._base(op, clock, wid)
+        ev["handle"] = int(handle)
+        if n is not None:
+            ev["n"] = int(n)
+        if start is not None:
+            ev["start"] = int(start)
+        if end is not None:
+            ev["end"] = int(end)
+        self._append(ev)
+
+    def record_sync(self, forced: bool, all_channels: bool,
+                    bytes_shipped: int) -> None:
+        """A completed sync round — replay re-drives these events
+        instead of running the timer-driven background loop (the
+        determinism lever: rounds happen where the WORKLOAD put them,
+        not where a wall clock did)."""
+        ev = self._base("sync", self._server_clock())
+        ev["forced"] = bool(forced)
+        ev["all"] = bool(all_channels)
+        ev["bytes"] = int(bytes_shipped)
+        self._append(ev)
+
+    def record_quiesce(self) -> None:
+        self._append(self._base("quiesce", self._server_clock()))
+
+    def record_decision(self, kind: str, n: int, **fields) -> None:
+        """A management decision as it landed (kind in {"reloc",
+        "promote"}): observational — replay lets the candidate policy
+        re-decide, and the recorded stream is the baseline it is
+        scored against."""
+        ev = self._base(kind, self._server_clock())
+        ev["n"] = int(n)
+        for k, v in fields.items():
+            ev[k] = v
+        self._append(ev)
+
+    # -- meta / stats --------------------------------------------------------
+
+    def _meta(self) -> Dict:
+        import dataclasses
+        import enum
+        srv = self._server
+        lens = srv.value_lengths
+        uniform = len(np.unique(lens)) == 1
+        knobs = {}
+        for k, v in dataclasses.asdict(srv.opts).items():
+            knobs[k] = v.value if isinstance(v, enum.Enum) else v
+        return {"num_keys": int(srv.num_keys),
+                "value_lengths": (int(lens[0]) if uniform
+                                  else [int(x) for x in lens]),
+                "num_shards": int(srv.ctx.num_shards),
+                "rank": int(srv.pid),
+                "key_budget": self.key_budget,
+                "wall_t0": self.wall_t0,
+                "mono_t0": self.mono_t0,
+                "knobs": knobs}
+
+    def stats(self) -> Dict:
+        """Plain-value summary for `metrics_snapshot()["wtrace"]` (the
+        registry-backed wtrace.* counters land in the same section)."""
+        with self._lock:
+            n = len(self._events)
+        return {"path": self.path, "events_buffered": n,
+                "flushes": self._flushes, "closed": self._closed}
+
+    # -- flush / close -------------------------------------------------------
+
+    def flush(self) -> str:
+        """Write the full trace (header line + checksummed JSON body)
+        atomically; returns the path. Safe to call mid-run for a
+        point-in-time trace (concurrent flushes serialize on the flush
+        lock, so the file on disk is always SOME complete snapshot and
+        snapshots publish in order); close() performs the final
+        flush."""
+        with self._flush_lock:
+            with self._lock:
+                doc = {"meta": self._meta(),
+                       "events": list(self._events),
+                       "dropped": int(self.c_dropped.value)}
+            body = json.dumps(doc, separators=(",", ":")).encode()
+            header = json.dumps(
+                {"format": WTRACE_FORMAT, "version": WTRACE_VERSION,
+                 "body_sha256": hashlib.sha256(body).hexdigest(),
+                 "body_bytes": len(body)}).encode()
+            d = os.path.dirname(self.path)
+            if d:
+                os.makedirs(d, exist_ok=True)
+            _write_atomic(self.path, header + b"\n" + body)
+            with self._lock:
+                self._flushes += 1
+            self.g_bytes.set(float(len(header) + 1 + len(body)))
+        return self.path
+
+    def close(self) -> None:
+        """Final flush + seal (idempotent). Events recorded after close
+        are ignored — the server is tearing down and the file on disk
+        is the trace."""
+        with self._lock:
+            if self._closed:
+                return
+        self.flush()
+        with self._lock:
+            self._closed = True
+
+
+# ---------------------------------------------------------------------------
+# loading (shared by the replay engine and tooling)
+# ---------------------------------------------------------------------------
+
+
+class WorkloadTrace:
+    """A verified, parsed `.wtrace`: `meta` dict + `events` list (seq
+    order). Construction implies the checksum passed."""
+
+    __slots__ = ("path", "meta", "events", "dropped")
+
+    def __init__(self, path: str, meta: Dict, events: List[Dict],
+                 dropped: int):
+        self.path = path
+        self.meta = meta
+        self.events = events
+        self.dropped = dropped
+
+    @property
+    def value_lengths(self):
+        return self.meta["value_lengths"]
+
+    def max_worker_id(self) -> int:
+        return max((ev.get("wid", 0) for ev in self.events), default=0)
+
+    def kinds(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for ev in self.events:
+            out[ev["kind"]] = out.get(ev["kind"], 0) + 1
+        return out
+
+
+def event_keys(ev: Dict, rng: Optional[np.random.Generator] = None,
+               ) -> np.ndarray:
+    """The event's key batch. Exact events return their recorded keys;
+    sampled events reconstruct a batch of the TRUE size by drawing from
+    the recorded sample — deterministic given the caller's seeded
+    `rng` (required for sampled events: reconstruction without a seed
+    would be a silent nondeterminism hole)."""
+    if "keys" in ev:
+        return np.asarray(ev["keys"], dtype=np.int64)
+    sample = np.asarray(ev["sample"], dtype=np.int64)
+    if rng is None:
+        raise ValueError(
+            f"event seq={ev.get('seq')} was key-sampled at capture "
+            f"(n={ev['n']} > budget); reconstructing its batch needs "
+            f"a seeded rng")
+    return rng.choice(sample, size=int(ev["n"]), replace=True)
+
+
+def load_wtrace(path: str) -> WorkloadTrace:
+    """Read + verify a `.wtrace` file. Raises `WorkloadTraceError` on a
+    missing/truncated/corrupt/incompatible file — named, and BEFORE any
+    replay state exists."""
+    try:
+        with open(path, "rb") as f:
+            raw = f.read()
+    except OSError as e:
+        raise WorkloadTraceError(
+            f"cannot read workload trace {path!r}: {e}") from e
+    nl = raw.find(b"\n")
+    if nl < 0:
+        raise WorkloadTraceError(
+            f"workload trace {path!r}: missing header line "
+            f"(truncated or not a .wtrace file)")
+    try:
+        header = json.loads(raw[:nl])
+    except ValueError as e:
+        raise WorkloadTraceError(
+            f"workload trace {path!r}: unparseable header: {e}") from e
+    if header.get("format") != WTRACE_FORMAT:
+        raise WorkloadTraceError(
+            f"workload trace {path!r}: format "
+            f"{header.get('format')!r} is not {WTRACE_FORMAT!r}")
+    if header.get("version") != WTRACE_VERSION:
+        raise WorkloadTraceError(
+            f"workload trace {path!r}: version "
+            f"{header.get('version')!r} unsupported (this build reads "
+            f"v{WTRACE_VERSION})")
+    body = raw[nl + 1:]
+    want_bytes = header.get("body_bytes")
+    if want_bytes != len(body):
+        raise WorkloadTraceError(
+            f"workload trace {path!r}: body is {len(body)} bytes, "
+            f"header promised {want_bytes} (truncated write?)")
+    digest = hashlib.sha256(body).hexdigest()
+    if digest != header.get("body_sha256"):
+        raise WorkloadTraceError(
+            f"workload trace {path!r}: body sha256 mismatch "
+            f"(bit flip / partial overwrite) — refusing to replay")
+    try:
+        doc = json.loads(body)
+        meta = doc["meta"]
+        events = doc["events"]
+    except (ValueError, KeyError, TypeError) as e:
+        raise WorkloadTraceError(
+            f"workload trace {path!r}: checksummed body failed to "
+            f"parse ({e}) — file written by an incompatible "
+            f"recorder?") from e
+    return WorkloadTrace(path, meta, events, int(doc.get("dropped", 0)))

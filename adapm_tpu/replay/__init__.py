@@ -1,0 +1,19 @@
+"""Deterministic trace replay: the offline policy lab + capacity
+simulator (ISSUE 15 tentpole, replay half; ROADMAP item 3).
+
+A workload captured once with `--sys.trace.workload` (obs/wtrace.py) is
+re-driven here against a FRESH in-process server under candidate knob
+overrides, at 1x-100x logical speed, and scored from the existing
+metrics snapshot — no live traffic, no hardware beyond this process.
+`rank_candidates` sweeps a set of knob overrides over one trace and
+emits a ranked comparison artifact; docs/REPLAY.md has the
+policy-scoring and capacity-sim recipes, and the determinism contract
+(same trace + same seed + same knobs => bit-identical replayed reads,
+pinned by tests/test_wtrace.py and scripts/trace_replay_check.py).
+"""
+from __future__ import annotations
+
+from ..obs.wtrace import (WorkloadTrace, WorkloadTraceError,  # noqa: F401
+                          load_wtrace)
+from .engine import (OBJECTIVES, ReplayEngine,  # noqa: F401
+                     per_shard_hot_rows, rank_candidates, replay_trace)

@@ -110,6 +110,13 @@ class ServeSession:
         lens = srv.value_lengths[keys]
         if deadline_ms is None:
             deadline_ms = self.plane.opts.serve_deadline_ms
+        wt = srv.wtrace  # workload trace capture (ISSUE 15; the serve
+        # half of the op stream: keys + tenant/priority/deadline)
+        if wt is not None:
+            wt.record_serve(
+                keys,
+                self.tenant.name if self.tenant is not None else None,
+                self.priority, deadline_ms or 0.0)
         deadline_s = None if not deadline_ms else deadline_ms * 1e-3
         after = ()
         if self.worker is not None and srv.glob is not None:

@@ -435,6 +435,12 @@ class PromotionEngine:
             n = ensure_hot_rows(srv, st, sh, sl, min_clock=min_clock)
         if n:
             self.manager.c_promotions.inc(n)
+            wt = srv.wtrace
+            if wt is not None:
+                # promotion decision as it landed (ISSUE 15):
+                # observational — replay's candidate tier policy
+                # re-decides; the recorded stream is the baseline
+                wt.record_decision("promote", n)
         return n
 
     @staticmethod

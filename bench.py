@@ -677,7 +677,11 @@ def bench_serve(E=20_000, vlen=32, clients=32, lookups_per_client=40,
     # artifact carries per-tenant qps/P99/shed and replica_hit_rate
     # next to the closed-loop numbers above.
     _progress("serve phase: mixed-tenant open-loop segment")
-    srv.flight = None
+    # keep the flight tracer ATTACHED through this segment (ISSUE 15
+    # satellite): the pusher + serve load below are exactly what the
+    # r12 freshness probe measures — push wall time -> first servable
+    # read — and the artifact finally surfaces flight.freshness_s
+    # P50/P99 instead of dropping the probe on the floor
     srv.opts.serve_slo_ms = 0.0
     srv.opts.serve_max_wait_us = 200   # undo the SLO segment's 4x window
     srv.opts.serve_dispatchers = 2
@@ -775,7 +779,26 @@ def bench_serve(E=20_000, vlen=32, clients=32, lookups_per_client=40,
             "served": int(bronze_ten.c_served.value),
             "shed": int(bronze_ten.c_shed.value +
                         bronze_ten.c_rejected.value)}}
+    # event-to-servable freshness (ISSUE 15 satellite; the r12 probe
+    # was never surfaced in the artifact): P50/P99 of
+    # flight.freshness_s over the tenant segment's concurrent
+    # push/serve traffic, via the same hist_percentile extraction the
+    # latency numbers use
+    h_fresh = srv.obs.find("flight.freshness_s")
+    fresh_snap = h_fresh.snap() if h_fresh is not None else None
+    freshness_out = {
+        "samples": int(fresh_snap["count"]) if fresh_snap else 0,
+        "p50_ms": round(1e3 * hist_percentile(fresh_snap, 0.50), 3)
+        if fresh_snap and fresh_snap["count"] else None,
+        "p99_ms": round(1e3 * hist_percentile(fresh_snap, 0.99), 3)
+        if fresh_snap and fresh_snap["count"] else None,
+        "evicted": int(srv.flight.freshness.evicted)
+        if srv.flight is not None else 0}
+    srv.flight = None   # detach before shutdown: no stray export
     plane3.close()
+    _progress(f"serve phase: freshness p50 {freshness_out['p50_ms']} "
+              f"ms / p99 {freshness_out['p99_ms']} ms over "
+              f"{freshness_out['samples']} samples")
     _progress(f"serve phase: mixed tenants — gold "
               f"{tenant_out['gold']['qps']} qps p99 "
               f"{tenant_out['gold']['p99_ms']} ms / bronze "
@@ -814,12 +837,108 @@ def bench_serve(E=20_000, vlen=32, clients=32, lookups_per_client=40,
            # qps/P99/shed under concurrent training pushes, and the
            # fraction of batches the read-only replica served lock-free
            "tenants": tenant_out,
+           # event-to-servable staleness over the tenant segment
+           # (ISSUE 15 satellite; flight.freshness_s, obs/flight.py)
+           "freshness": freshness_out,
            "metrics": snap}
-    # the tracer was already detached before the tenant segment; a
+    # the tracer was detached after the freshness extraction above; a
     # shutdown export would otherwise drop a flight.<rank>.trace.json
     # into the working directory
     srv.shutdown()
     return out
+
+
+def bench_replay(E=8_000, vlen=16, steps=120, skew=8.0):
+    """Trace-replay phase (ISSUE 15): capture a zipf pull/push/serve
+    workload once (--sys.trace.workload), then score a hot-capacity
+    knob sweep OFFLINE by deterministic replay (adapm_tpu/replay) —
+    the artifact carries the captured-trace shape, per-candidate
+    hot-hit/serve scores, the ranked comparison, and the determinism
+    digest (same seed + knobs => bit-identical reads, re-verified
+    here with a second run of the winner)."""
+    import tempfile
+
+    import adapm_tpu
+    from adapm_tpu.config import SystemOptions
+    from adapm_tpu.replay import (ReplayEngine, load_wtrace,
+                                  per_shard_hot_rows, rank_candidates)
+    from adapm_tpu.serve import ServePlane
+
+    # the .wtrace only needs to live until load_wtrace parses it; the
+    # context bounds the tempdir so no adapm_replay_* dir outlives the
+    # phase (success or failure)
+    with tempfile.TemporaryDirectory(prefix="adapm_replay_") as tmp:
+        path = os.path.join(tmp, "bench.wtrace")
+        _progress(f"replay phase: capturing workload ({E} keys x "
+                  f"{vlen}, {steps} steps)")
+        opts = SystemOptions(sync_max_per_sec=0, prefetch=False,
+                             trace_workload=path,
+                             trace_workload_keys=512)
+        srv = adapm_tpu.setup(E, vlen, opts=opts, num_workers=1)
+        w = srv.make_worker(0)
+        rng = np.random.default_rng(0)
+        w.wait(w.set(np.arange(E), np.ones((E, vlen), np.float32)))
+        plane = ServePlane(srv)
+        sess = plane.session()
+        t0 = time.perf_counter()
+        for i in range(steps):
+            ks = np.unique((E * rng.random(64) ** skew)
+                           .astype(np.int64).clip(0, E - 1))
+            w.pull_sync(ks)
+            w.wait(w.push(ks, np.ones((len(ks), vlen), np.float32)))
+            if i % 4 == 0:
+                sess.lookup((E * rng.random(32) ** skew)
+                            .astype(np.int64).clip(0, E - 1))
+            if i % 10 == 9:
+                w.advance_clock()
+                srv.wait_sync()
+        srv.quiesce()
+        t_capture = time.perf_counter() - t0
+        plane.close()
+        srv.shutdown()
+        tr = load_wtrace(path)
+    # per_shard_hot_rows: --sys.tier.hot_rows is PER SHARD, so these
+    # whole-table fractions divide by the device count (the helper is
+    # shared with scripts/trace_replay_check.py)
+    candidates = {
+        "hot_25pct": {"tier": True,
+                      "tier_hot_rows": per_shard_hot_rows(E, 0.25)},
+        "hot_50pct": {"tier": True,
+                      "tier_hot_rows": per_shard_hot_rows(E, 0.50)},
+        "hot_100pct": {"tier": True,
+                       "tier_hot_rows": per_shard_hot_rows(E, 1.0)},
+    }
+    _progress(f"replay phase: ranking {len(candidates)} candidates "
+              f"over {len(tr.events)} events")
+    # speed 10, not 100: at full compression the replay leaves the
+    # background promotion worker no think-time between ops, so every
+    # capacity candidate is promotion-bandwidth-bound and the sweep
+    # near-ties — 10x keeps the gap shape while letting capacity be
+    # the variable under test (docs/REPLAY.md "Choosing a speed")
+    art = rank_candidates(tr, candidates, objective="hot_hit_rate",
+                          seed=7, speed=10.0)
+    # determinism re-verified on the winner (the full guard is
+    # scripts/trace_replay_check.py)
+    win = art["winner"]
+    redo = ReplayEngine(tr, overrides=candidates[win], seed=7,
+                        speed=10.0).run()
+    deterministic = redo["reads_digest"] == \
+        art["candidates"][win]["reads_digest"]
+    _progress(f"replay phase: winner {win} "
+              f"(hot_hit_rate "
+              f"{art['candidates'][win]['score']['hot_hit_rate']}), "
+              f"deterministic={deterministic}")
+    return {"capture_s": round(t_capture, 3),
+            "trace_events": len(tr.events),
+            "trace_kinds": tr.kinds(),
+            "replay_deterministic": bool(deterministic),
+            "winner": win,
+            "ranking": art["ranking"],
+            "objective": art["objective"],
+            "scores": {n: art["candidates"][n]["score"]
+                       for n in candidates},
+            "replay_wall_s": {n: art["candidates"][n]["wall_s"]
+                              for n in candidates}}
 
 
 def bench_tier(E=40_000, d=32, B=1024, steps=60, warmup=20,
@@ -1444,6 +1563,17 @@ def _phase_fault():
     return out
 
 
+def _phase_replay():
+    import jax
+    sz = {"E": 2_048, "steps": 100} \
+        if os.environ.get("ADAPM_BENCH_SMALL") else {}
+    out = bench_replay(**sz)
+    out["virtual_shards"] = len(jax.devices("cpu"))
+    if sz:
+        out["small_sizes"] = sz
+    return out
+
+
 def _phase_w2v():
     if os.environ.get("ADAPM_BENCH_SMALL"):
         small = dict(V=20_000, d=64, B=2048, warmup=2)
@@ -1476,15 +1606,15 @@ _PHASES = {"probe": _phase_probe, "kge": _phase_kge,
            "compress": _phase_compress, "serve": _phase_serve,
            "tier": _phase_tier, "exec": _phase_exec,
            "episodic": _phase_episodic,
-           "fault": _phase_fault, "w2v": _phase_w2v,
-           "cpu": _phase_cpu}
+           "fault": _phase_fault, "replay": _phase_replay,
+           "w2v": _phase_w2v, "cpu": _phase_cpu}
 
 # generous per-phase walls: a healthy phase finishes in a fraction of
 # these; a wedged relay burns one wall once, then the driver degrades
 _TIMEOUTS = {"probe": 120, "kge": 1200, "prefetch": 1200, "scan": 900,
              "dedup": 900, "pm": 900, "mgmt": 900, "compress": 900,
              "serve": 900, "tier": 900, "exec": 900, "episodic": 900,
-             "fault": 900, "w2v": 900, "cpu": 600}
+             "fault": 900, "replay": 900, "w2v": 900, "cpu": 600}
 
 _CPU_ENV = {"JAX_PLATFORMS": "cpu", "ADAPM_PLATFORM": "cpu",
             "ADAPM_BENCH_SMALL": "1"}
@@ -1635,6 +1765,10 @@ def main():
     # robustness phase (ISSUE 10): host-CPU by design — incremental
     # checkpoint bytes and recovery wall time are host serialization
     results["fault"] = _run_phase("fault", pm_env)
+    # trace-replay phase (ISSUE 15): host-CPU by design — capture +
+    # deterministic offline knob sweep are host-driven, and the
+    # determinism digest must not depend on which backend ran it
+    results["replay"] = _run_phase("replay", pm_env)
     results["cpu"] = _run_phase("cpu")
 
     def phase_val(name, field):
@@ -1722,6 +1856,8 @@ def main():
                  else {"error": "exec failed"}),
         "fault": (results["fault"] if _ok(results["fault"])
                   else {"error": "fault failed"}),
+        "replay": (results["replay"] if _ok(results["replay"])
+                   else {"error": "replay failed"}),
         "w2v_pairs_per_sec": round(w2v, 1),
         "dedup": {"unique_batch_triples_per_sec": round(tput_unique, 1),
                   "gain_vs_skewed":

@@ -102,6 +102,21 @@ def main() -> int:
         f"default-off fault plane registered metrics: {fault_names}"
     print("[overhead-check] fault injection default-off: no plane, "
           "zero fault.* names; injection points are zero-cost skips")
+    # ISSUE 15: workload trace capture is compiled in but DEFAULT OFF —
+    # no recorder object, zero wtrace.* registry names, and every
+    # capture hook (worker pull/push/set, intent, clock, serve submit,
+    # sync round, relocation, promotion) pays one `is None` check. The
+    # unchanged median-ratio guard below times the pull/push hot path
+    # with those branches present.
+    assert srv.wtrace is None, \
+        "workload capture must be DEFAULT OFF (--sys.trace.workload " \
+        "unset)"
+    wtrace_names = [n for n in names if n.startswith("wtrace.")]
+    assert not wtrace_names, \
+        f"default-off workload capture registered metrics: " \
+        f"{wtrace_names}"
+    print("[overhead-check] workload capture default-off: no recorder, "
+          "zero wtrace.* names; capture hooks are zero-cost skips")
     saved = (w._h_pull, w._h_push, w._h_set, srv.sync._h_round)
     probe(w, batches, vals, 30)  # warm the jit caches
     # per-pair (off, on) timings back to back; the guard is the MEDIAN

@@ -60,6 +60,11 @@ python scripts/compress_drift_check.py
 # max_wait_us DOWN and land the observed serve P99 within the tolerance
 # band of the target (median of trailing measurement windows)
 python scripts/slo_convergence_check.py
+# trace-replay guard (ISSUE 15): a captured multi-plane storm must
+# replay bit-identically (same seed + knobs, across 1x/10x logical
+# speed), and a two-candidate knob sweep's ranked artifact must pick
+# the same winner as the live-measured ordering on the same workload
+python scripts/trace_replay_check.py
 # fault drill (ISSUE 10): a seeded push/serve/promote/sync storm under
 # injected transient faults must stay bit-identical to an uninjected
 # shadow; a server killed mid-storm must restore from the incremental

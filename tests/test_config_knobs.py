@@ -384,3 +384,37 @@ def test_episode_batches_knob_round_trip_and_rejection():
     with pytest.raises(ValueError, match="episode.batches"):
         SystemOptions.from_args(p.parse_args(
             ["--sys.episode.batches", "0"]))
+
+
+def test_workload_trace_knobs_round_trip_and_rejection():
+    """--sys.trace.workload / --sys.trace.workload_keys (ISSUE 15):
+    parse into the options the WorkloadTraceRecorder consumes, default
+    OFF (no recorder, zero wtrace.* names — pinned by
+    tests/test_wtrace.py and scripts/metrics_overhead_check.py), and a
+    zero key budget is rejected at parse time AND on hand-built
+    options."""
+    import argparse
+
+    import pytest
+
+    from adapm_tpu.config import SystemOptions
+    p = argparse.ArgumentParser()
+    SystemOptions.add_arguments(p)
+    dflt = SystemOptions.from_args(p.parse_args([]))
+    assert dflt.trace_workload is None
+    assert dflt.trace_workload_keys == 4096
+    on = SystemOptions.from_args(p.parse_args(
+        ["--sys.trace.workload", "/tmp/run.wtrace",
+         "--sys.trace.workload_keys", "256"]))
+    assert on.trace_workload == "/tmp/run.wtrace"
+    assert on.trace_workload_keys == 256
+    # zero/negative key budget: an unreplayable trace, rejected loudly
+    with pytest.raises(ValueError, match="workload_keys"):
+        SystemOptions.from_args(p.parse_args(
+            ["--sys.trace.workload", "/tmp/run.wtrace",
+             "--sys.trace.workload_keys", "0"]))
+    with pytest.raises(ValueError, match="workload_keys"):
+        SystemOptions(trace_workload_keys=-1).validate_serve()
+    # non-integer budget rejected by argparse itself
+    with pytest.raises(SystemExit):
+        p.parse_args(["--sys.trace.workload_keys", "lots"])

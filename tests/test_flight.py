@@ -684,10 +684,46 @@ def test_reporter_line_format():
         == "no activity yet"
 
 
+def test_clock_domains_recorded_everywhere(tmp_path):
+    """ISSUE 15 clock-domain satellite: the flight-recorder ring and
+    the SLO move log each stamp BOTH wall time and a monotonic clock —
+    merged timelines (and replay alignment) must not skew when NTP
+    steps the wall clock. The tail merge orders by the MONOTONIC
+    stamp, which cannot step backwards."""
+    rec = FlightRecorder(path=str(tmp_path / "r.log"))
+    m0, w0 = time.monotonic(), time.time()
+    rec.record("sync", "a", None, 0.0, 0.001)
+    rec.record("serve", "b", None, 0.0, 0.001)
+    m1, w1 = time.monotonic(), time.time()
+    tail = rec.tail()
+    assert len(tail) == 2
+    for e in tail:
+        # both domains present, each bracketed by its own clock
+        assert m0 <= e["t_mono"] <= m1
+        assert w0 <= e["t"] <= w1 + 1.0
+    # merged tail is mono-ordered (wall could lie under an NTP step)
+    assert tail[0]["t_mono"] <= tail[1]["t_mono"]
+    rec.close()
+    # SLO move log: drive one adjustment and check the report entries
+    c, b, h = _mk_controller(target_ms=10.0, wait_us=20_000)
+    m0 = time.monotonic()
+    for _ in range(10):
+        h.observe(0.050)    # far over target -> shrink
+    c._control()
+    m1 = time.monotonic()
+    rep = c.report()
+    assert rep["adjustments"] == 1
+    first = rep["first_adjustment"]
+    last = rep["recent_adjustments"][-1]
+    for entry in (first, last):
+        assert m0 <= entry["t_mono"] <= m1
+        assert entry["t"] > 1e9  # epoch wall seconds, not monotonic
+    assert first == last
+
+
 def test_flight_recorder_unit(tmp_path):
-    """FlightRecorder mechanics: bounded per-stream rings, wall-time
-    merged tail, fixed-slot ring file overwrites (no unbounded
-    growth)."""
+    """FlightRecorder mechanics: bounded per-stream rings, mono-merged
+    tail, fixed-slot ring file overwrites (no unbounded growth)."""
     path = str(tmp_path / "ring.log")
     rec = FlightRecorder(path=path, per_stream=2, file_slots=4)
     for i in range(6):

@@ -266,6 +266,26 @@ class SystemOptions:
     # min interval between snapshot refreshes (the coalesced
     # `serve_refresh` executor program's throttle), in ms
     serve_replica_refresh_ms: float = 50.0
+    # fused embedding-bag reads (ISSUE 16; serve/bags.py): serve
+    # `ServeSession.lookup_bags` through ONE gather+pool device program
+    # per (length class, pooling) — only the pooled vectors cross the
+    # device boundary. Off = pool on the host after the flat union
+    # gather; bit-identical either way (the knob moves WHERE the
+    # reduction runs, never what it returns).
+    serve_bags: bool = True
+
+    # -- measured kernel cost table (sys.costs.*; adapm_tpu/ops/
+    #    costs.py, docs/PERF.md "Kernel cost table"): per-(variant,
+    #    length class, batch bucket, dtype, pooling) measured dispatch
+    #    costs, persisted as versioned JSON at costs_table. The serve
+    #    batcher consults it to pick fused vs host-pool bag dispatch;
+    #    the episodic planner sizes prep windows from the per-class
+    #    entries. No table (the default) = built-in preference order,
+    #    no file I/O anywhere.
+    costs_table: Optional[str] = None
+    # measure-and-write at server construction (one-time calibration
+    # pass over the cost probes; requires costs_table for the output)
+    costs_calibrate: bool = False
 
     # -- fault injection + error policy (sys.fault.*; adapm_tpu/fault,
     #    docs/failure_handling.md). The spec is `point=prob` pairs
@@ -408,6 +428,16 @@ class SystemOptions:
                 f"(got {self.serve_replica_refresh_ms}): a zero "
                 f"refresh throttle would let every snapshot miss queue "
                 f"an immediate refresh program")
+        if self.costs_table is not None and not self.costs_table:
+            raise ValueError(
+                "--sys.costs.table needs a non-empty path for the "
+                "cost-table JSON (omit the flag to run without a "
+                "measured table)")
+        if self.costs_calibrate and not self.costs_table:
+            raise ValueError(
+                "--sys.costs.calibrate requires --sys.costs.table: a "
+                "calibration pass measures kernel costs and must have "
+                "somewhere to persist them")
         if self.trace_workload_keys < 1:
             raise ValueError(
                 f"--sys.trace.workload_keys must be >= 1 "
@@ -566,6 +596,12 @@ class SystemOptions:
         g.add_argument("--sys.serve.replica_refresh_ms",
                        dest="sys_serve_replica_refresh_ms", type=float,
                        default=50.0)
+        g.add_argument("--sys.serve.bags", dest="sys_serve_bags",
+                       type=int, default=1)
+        g.add_argument("--sys.costs.table", dest="sys_costs_table",
+                       default=None)
+        g.add_argument("--sys.costs.calibrate",
+                       dest="sys_costs_calibrate", type=int, default=0)
         g.add_argument("--sys.fault.spec", dest="sys_fault_spec",
                        default="")
         g.add_argument("--sys.fault.seed", dest="sys_fault_seed",
@@ -655,6 +691,9 @@ class SystemOptions:
             serve_dispatchers=args.sys_serve_dispatchers,
             serve_replica_rows=args.sys_serve_replica_rows,
             serve_replica_refresh_ms=args.sys_serve_replica_refresh_ms,
+            serve_bags=bool(args.sys_serve_bags),
+            costs_table=args.sys_costs_table,
+            costs_calibrate=bool(args.sys_costs_calibrate),
             fault_spec=args.sys_fault_spec,
             fault_seed=args.sys_fault_seed,
             fault_retries=args.sys_fault_retries,

@@ -34,6 +34,7 @@ import numpy as np
 
 from ..base import CLOCK_MAX, LOCAL, WORKER_FINISHED, MgmtTechniques
 from ..config import SystemOptions
+from ..exec.executor import dispatch_gate
 from ..obs.spans import NULL_SPAN
 from ..parallel.mesh import MeshContext, get_mesh_context
 from .addressbook import Addressbook
@@ -323,6 +324,28 @@ class Server:
             # validation is skipped for hand-built SystemOptions)
             from ..tier.residency import TierManager
             self.tier = TierManager(self, self.opts)
+
+        # measured kernel cost table (ISSUE 16; ops/costs.py): attached
+        # when --sys.costs.table names a JSON table. calibrate=1
+        # measures on THIS server's live stores and persists; otherwise
+        # a missing/unreadable file just means no table (the built-in
+        # dispatch preferences apply — a measured table can only ever
+        # refine the choice, never be required). Consulted by the serve
+        # batcher's bag dispatch and the episodic prep sizing.
+        self.costs = None
+        if self.opts.costs_table:
+            from ..ops.costs import KernelCostTable, calibrate_server
+            if self.opts.costs_calibrate:
+                self.costs = calibrate_server(self)
+                self.costs.save(self.opts.costs_table)
+            else:
+                try:
+                    self.costs = KernelCostTable.load(
+                        self.opts.costs_table)
+                except OSError:
+                    self.costs = None
+            if self.costs is not None:
+                self.costs.bind_metrics(self.obs)
 
         # routing-plan cache + intent-driven prefetch pipeline (the hot
         # Pull/Push path levers; core/intent.py). Both revalidate against
@@ -624,15 +647,26 @@ class Server:
             fut = self.glob.pull_async(rem_keys, after=after)
             remote = (rem_pos, fut)
             n_remote = len(rem_pos)
-        for cid, pos, ks, (o_sh, o_sl, c_sh, c_sl, use_c, nr,
-                           local) in cls:
-            n_remote += nr
-            if self.locality is not None:
-                self.locality.record(ks.ravel(), local.ravel())
-            o_sl = np.where(use_c, OOB, o_sl).astype(np.int32)
-            vals = self.stores[cid].gather(o_sh, o_sl, c_sh, c_sl, use_c)
-            gpos = pos if loc_map is None else loc_map[pos]
-            groups.append((cid, gpos, self.value_lengths[ks], vals, len(ks)))
+        # Multi-class batches: issue every class's gather back-to-back
+        # under ONE dispatch-gate hold (ISSUE 16 satellite). Each
+        # store.gather re-acquires the (reentrant) gate per program, so
+        # without the outer hold a concurrent serve/step dispatcher could
+        # interleave between classes and the per-class enqueues would
+        # serialize behind it; holding the gate across the loop keeps the
+        # enqueue train contiguous. The gate is a leaf lock, so taking it
+        # while holding the server lock is in-order (APM004).
+        with dispatch_gate():
+            for cid, pos, ks, (o_sh, o_sl, c_sh, c_sl, use_c, nr,
+                               local) in cls:
+                n_remote += nr
+                if self.locality is not None:
+                    self.locality.record(ks.ravel(), local.ravel())
+                o_sl = np.where(use_c, OOB, o_sl).astype(np.int32)
+                vals = self.stores[cid].gather(o_sh, o_sl, c_sh, c_sl,
+                                               use_c)
+                gpos = pos if loc_map is None else loc_map[pos]
+                groups.append((cid, gpos, self.value_lengths[ks], vals,
+                               len(ks)))
         return groups, n_remote, remote
 
     def _plan_push_routes(self, keys: np.ndarray, shard: int,
@@ -1645,8 +1679,20 @@ class Server:
         on a server DRIVEN by the offline replay engine
         (adapm_tpu/replay): events replayed/skipped, the replay seed
         and logical speed, and the reads digest the determinism
-        contract pins; `{}` everywhere else."""
-        out: Dict = {"schema_version": 11,
+        contract pins; `{}` everywhere else.
+
+        schema_version 12 (PR 16): the serve section gains the fused
+        bag-read counters (ISSUE 16; serve/bags.py) —
+        `serve.bag_lookups_total` / `serve.bag_pooled_total` and the
+        per-batch dispatch split `serve.bag_fused_total` /
+        `serve.bag_hostpool_total` / `serve.bag_replica_hits_total` —
+        and the device section gains the measured kernel-cost-table
+        accounting (ops/costs.py, `--sys.costs.table`):
+        `device.costs_consults_total` / `device.costs_overrides_total`
+        / `device.costs_calibrations_total` and the
+        `device.costs_entries` gauge, absent until a table is
+        attached."""
+        out: Dict = {"schema_version": 12,
                      "metrics_enabled": bool(self.obs.enabled)}
         for s in self._SNAPSHOT_SECTIONS:
             out[s] = {}

@@ -340,6 +340,32 @@ class ShardedStore:
                        minimum=self.bucket_min)
         return self.port.gather(self.main, self.cache, self.delta, *a)
 
+    def gather_pool(self, o_shard, o_slot, c_shard, c_slot, use_cache,
+                    seg, nbags: int, pooling: str = "sum"):
+        """Fused embedding-bag read (ISSUE 16): gather member rows
+        exactly as `gather` and reduce them into per-bag vectors in ONE
+        port program. `seg` maps each member entry to its bag index
+        (< nbags); the result's first `nbags` rows are the pooled
+        vectors (the rest is bucket padding — slice `[:nbags]`).
+        Bit-identical to host-pooling this batch's `gather` rows with
+        `np.add.at` (the batch-order accumulation contract)."""
+        n = len(o_shard)
+        self.gathers += 1
+        nb = bucket_size(max(int(nbags), 1), self.bucket_min)
+        out = np.zeros((nb, self.value_length),
+                       dtype=np.dtype(self.dtype))
+        if self.res is not None:
+            from ..tier import coldpath
+            return coldpath.gather_pool_tiered(
+                self, o_shard, o_slot, c_shard, c_slot, use_cache,
+                seg, out, pooling)
+        a = pad_bucket(n, (o_shard, 0), (o_slot, OOB), (c_shard, 0),
+                       (c_slot, OOB), (use_cache, False),
+                       (np.asarray(seg, dtype=np.int32), OOB),
+                       minimum=self.bucket_min)
+        return self.port.gather_pool(self.main, self.cache, self.delta,
+                                     *a, out, pooling=pooling)
+
     def stage_gather(self, o_shard, o_slot, c_shard, c_slot, use_cache,
                      pool: "StagingPool"):
         """The gather-into-staging program (prefetch pipeline): identical

@@ -85,8 +85,18 @@ class EpisodicRunner:
         self.runner = runner
         self.server = runner.server
         srv = self.server
-        self.episode_batches = int(episode_batches
-                                   or srv.opts.episode_batches)
+        eb = episode_batches or srv.opts.episode_batches
+        # measured prep sizing (ISSUE 16; ops/costs.py): with an
+        # attached kernel cost table and no explicit override, size the
+        # window from the per-class measured gather costs — slow/wide
+        # classes prep shorter episodes so host prep cannot outrun the
+        # overlapped commit. An explicit episode_batches (arg or a
+        # table-less server) keeps the static knob untouched.
+        if episode_batches is None and getattr(srv, "costs",
+                                               None) is not None:
+            eb = srv.costs.suggest_episode_batches(
+                eb, [st.value_length for st in srv.stores])
+        self.episode_batches = int(eb)
         assert self.episode_batches >= 1
         # key staging is a DeviceRoutedRunner capability; the host-routed
         # FusedStepRunner still gets episodic pin/promote prep

@@ -58,6 +58,39 @@ def _gather(main, cache, delta, o_shard, o_slot, c_shard, c_slot, use_cache):
     return jnp.where(use_cache[:, None], c, m)
 
 
+def _pool_rows(rows, seg, out, pooling):
+    """Reduce gathered member rows into per-bag vectors (inlined by the
+    _gather_pool* programs). Sum accumulates in BATCH ORDER — the same
+    order `np.add.at` applies on host (core/tier/coldpath.py contract),
+    so a fused pooled read is bit-identical to host-pooling the same
+    gathered rows. Mean divides the batch-order sum by the member count
+    once (single fp division; the host twin divides identically).
+    Padding members carry seg=OOB and drop from both scatters."""
+    summed = out.at[seg].add(rows, mode="drop")
+    if pooling == "sum":
+        return summed
+    cnt = jnp.zeros(out.shape[0], rows.dtype).at[seg].add(
+        jnp.ones(seg.shape[0], rows.dtype), mode="drop")
+    return jnp.where(cnt[:, None] > 0, summed / cnt[:, None],
+                     jnp.zeros_like(summed))
+
+
+@partial(jax.jit, static_argnames=("pooling",))
+def _gather_pool(main, cache, delta, o_shard, o_slot, c_shard, c_slot,
+                 use_cache, seg, out, *, pooling):
+    """Fused embedding-bag read (ISSUE 16): `_gather`'s member-row read
+    followed by the in-program segment reduction — one dispatch per
+    (length class, pooling) instead of gather + host pool. Nothing is
+    donated (the `out` buffer is a fresh host array per call), so the
+    family contributes empty entries to APM005's auto-derived donation
+    map by construction."""
+    m = main.at[o_shard, o_slot].get(mode="fill", fill_value=0)
+    c = (cache.at[c_shard, c_slot].get(mode="fill", fill_value=0)
+         + delta.at[c_shard, c_slot].get(mode="fill", fill_value=0))
+    rows = jnp.where(use_cache[:, None], c, m)
+    return _pool_rows(rows, seg, out, pooling)
+
+
 @partial(jax.jit, donate_argnums=(0, 1))
 def _scatter_add(main, delta, o_shard, o_slot, d_shard, d_slot, vals):
     """Push: each row routed either to main (owner path; d_slot=OOB) or to a
@@ -232,6 +265,20 @@ def _gather_cold(main, cache, delta, o_shard, o_row, c_shard, c_slot,
     return jnp.where(use_cache[:, None], c, m)
 
 
+@partial(jax.jit, static_argnames=("pooling",))
+def _gather_pool_cold(main, cache, delta, o_shard, o_row, c_shard,
+                      c_slot, use_cache, cold_vals, use_cold, seg, out,
+                      *, pooling):
+    """`_gather_pool` with `_gather_cold`'s host-supplied row override
+    for cold owner members."""
+    m = main.at[o_shard, o_row].get(mode="fill", fill_value=0)
+    m = jnp.where(use_cold[:, None], cold_vals, m)
+    c = (cache.at[c_shard, c_slot].get(mode="fill", fill_value=0)
+         + delta.at[c_shard, c_slot].get(mode="fill", fill_value=0))
+    rows = jnp.where(use_cache[:, None], c, m)
+    return _pool_rows(rows, seg, out, pooling)
+
+
 @partial(jax.jit, donate_argnums=(0,))
 def _clear_rows(arr, sh, sl):
     """Zero rows (relocation's replica-delta consume on the host path)."""
@@ -290,6 +337,33 @@ def _gather_cold_int8(main, cache, delta, o_shard, o_row, c_shard,
     return jnp.where(use_cache[:, None], c, m)
 
 
+@partial(jax.jit, static_argnames=("pooling",))
+def _gather_pool_cold_fp16(main, cache, delta, o_shard, o_row, c_shard,
+                           c_slot, use_cache, cold_q, use_cold, seg,
+                           out, *, pooling):
+    """Bag read over fp16 wire cold rows: dequant + pooling fused."""
+    m = main.at[o_shard, o_row].get(mode="fill", fill_value=0)
+    m = jnp.where(use_cold[:, None], cold_q.astype(main.dtype), m)
+    c = (cache.at[c_shard, c_slot].get(mode="fill", fill_value=0)
+         + delta.at[c_shard, c_slot].get(mode="fill", fill_value=0))
+    rows = jnp.where(use_cache[:, None], c, m)
+    return _pool_rows(rows, seg, out, pooling)
+
+
+@partial(jax.jit, static_argnames=("pooling",))
+def _gather_pool_cold_int8(main, cache, delta, o_shard, o_row, c_shard,
+                           c_slot, use_cache, cold_q, cold_scale,
+                           use_cold, seg, out, *, pooling):
+    """Bag read over int8+scale wire cold rows."""
+    m = main.at[o_shard, o_row].get(mode="fill", fill_value=0)
+    deq = cold_q.astype(main.dtype) * cold_scale[:, None]
+    m = jnp.where(use_cold[:, None], deq, m)
+    c = (cache.at[c_shard, c_slot].get(mode="fill", fill_value=0)
+         + delta.at[c_shard, c_slot].get(mode="fill", fill_value=0))
+    rows = jnp.where(use_cache[:, None], c, m)
+    return _pool_rows(rows, seg, out, pooling)
+
+
 @partial(jax.jit, donate_argnums=(0,))
 def _write_main_rows(main, sh, row, vals):
     """Install host rows into the hot pool (promotion upload; padding
@@ -346,6 +420,14 @@ class JaxDevicePort(DevicePort):
         with _GATE:
             return _gather(main, cache, delta, o_shard, o_slot,
                            c_shard, c_slot, use_cache)
+
+    def gather_pool(self, main, cache, delta, o_shard, o_slot, c_shard,
+                    c_slot, use_cache, seg, out, pooling="sum"):
+        self.programs += 1
+        with _GATE:
+            return _gather_pool(main, cache, delta, o_shard, o_slot,
+                                c_shard, c_slot, use_cache, seg, out,
+                                pooling=pooling)
 
     def scatter_add(self, main, delta, o_shard, o_slot, d_shard,
                     d_slot, vals):
@@ -462,6 +544,35 @@ class JaxDevicePort(DevicePort):
             return _gather_cold_int8(main, cache, delta, o_shard,
                                      o_row, c_shard, c_slot, use_cache,
                                      cold_q, cold_scale, use_cold)
+
+    def gather_pool_cold(self, main, cache, delta, o_shard, o_row,
+                         c_shard, c_slot, use_cache, cold_vals,
+                         use_cold, seg, out, pooling="sum"):
+        self.programs += 1
+        with _GATE:
+            return _gather_pool_cold(main, cache, delta, o_shard,
+                                     o_row, c_shard, c_slot, use_cache,
+                                     cold_vals, use_cold, seg, out,
+                                     pooling=pooling)
+
+    def gather_pool_cold_wire(self, mode: str, main, cache, delta,
+                              o_shard, o_row, c_shard, c_slot,
+                              use_cache, cold_q, cold_scale, use_cold,
+                              seg, out, pooling="sum"):
+        self.programs += 1
+        # real wire rows only, same convention as gather_cold_wire
+        self.wire_ingest_rows += int(np.count_nonzero(
+            np.asarray(use_cold)))
+        with _GATE:
+            if mode == "fp16":
+                return _gather_pool_cold_fp16(
+                    main, cache, delta, o_shard, o_row, c_shard,
+                    c_slot, use_cache, cold_q, use_cold, seg, out,
+                    pooling=pooling)
+            return _gather_pool_cold_int8(
+                main, cache, delta, o_shard, o_row, c_shard, c_slot,
+                use_cache, cold_q, cold_scale, use_cold, seg, out,
+                pooling=pooling)
 
     def write_main_rows(self, main, sh, row, vals):
         self.programs += 1

@@ -56,6 +56,18 @@ class DevicePort:
                c_slot, use_cache):
         raise NotImplementedError
 
+    def gather_pool(self, main, cache, delta, o_shard, o_slot, c_shard,
+                    c_slot, use_cache, seg, out, pooling="sum"):
+        """Fused embedding-bag read (ISSUE 16): gather member rows
+        exactly as `gather` and reduce them into `out[seg[i]]` in ONE
+        program — sum pooling accumulates in batch order (the same
+        order `np.add.at` uses on host, so fused-vs-host-pooled results
+        are bit-identical by construction); mean divides the batch-order
+        sum by the per-bag member count once. `seg` carries OOB for
+        padding members (dropped by the pooling scatter); `out` is a
+        zeroed [n_bags_bucket, L] host buffer fixing the output shape."""
+        raise NotImplementedError
+
     def scatter_add(self, main, delta, o_shard, o_slot, d_shard,
                     d_slot, vals):
         """Donates (main, delta); returns (main, delta)."""
@@ -107,6 +119,21 @@ class DevicePort:
                          cold_scale, use_cold):
         """Cold-miss gather with still-quantized cold rows (`mode` in
         fp16/int8); dequant fuses into the program."""
+        raise NotImplementedError
+
+    def gather_pool_cold(self, main, cache, delta, o_shard, o_row,
+                         c_shard, c_slot, use_cache, cold_vals,
+                         use_cold, seg, out, pooling="sum"):
+        """`gather_pool` with the host-supplied cold-row override
+        (`gather_cold` semantics for the member gather half)."""
+        raise NotImplementedError
+
+    def gather_pool_cold_wire(self, mode: str, main, cache, delta,
+                              o_shard, o_row, c_shard, c_slot,
+                              use_cache, cold_q, cold_scale, use_cold,
+                              seg, out, pooling="sum"):
+        """`gather_pool` over still-quantized cold rows (`mode` in
+        fp16/int8): dequant AND pooling both fuse into one program."""
         raise NotImplementedError
 
     def write_main_rows(self, main, sh, row, vals):

@@ -143,6 +143,9 @@ SHARDED_DISPATCH_SITES = frozenset({
     # tier/coldpath.py (cold-path programs)
     "_gather_cold", "_gather_cold_fp16", "_gather_cold_int8",
     "_clear_rows", "_install_cache_rows", "_install_cache_rows_resid",
+    # fused embedding-bag reads (device/jaxport.py, ISSUE 16)
+    "_gather_pool", "_gather_pool_cold", "_gather_pool_cold_fp16",
+    "_gather_pool_cold_int8",
     # utils/checkpoint.py (restore launder)
     "_launder_fn",
 })
@@ -905,6 +908,11 @@ _DEVICE_API_NAMES = frozenset({"shard_map"})
 # DevicePort method (store dispatches, port.compile for fused steps,
 # port.compile_collective for exchanges, port.put_* for transfers), so
 # a new backend is one new port class — the ISSUE 14 refactor contract.
+# device/refport.py (the pure-NumPy reference port, ISSUE 16) sits
+# inside the allowlist but deliberately needs none of it: it imports no
+# jax at all, which scripts/portdiff_check.py asserts — the existence
+# proof that the DevicePort seam is honest (a backend that never
+# touches the device APIs still passes every storm bitwise).
 DEVICE_PLANE_ALLOWLIST = ("adapm_tpu/device/",)
 
 

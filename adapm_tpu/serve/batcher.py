@@ -54,8 +54,10 @@ from typing import List, Optional
 
 import numpy as np
 
+from ..exec.executor import dispatch_gate
 from ..obs.metrics import BATCH_SIZE_BOUNDS, SERVE_LATENCY_BOUNDS_S
 from .admission import AdmissionQueue, LookupRequest, ServeDegradedError
+from .bags import BagLookupRequest, plan_bag_batch, pool_bags_host
 
 
 class LookupBatcher:
@@ -122,6 +124,21 @@ class LookupBatcher:
                                        shared=True)
         self.h_batch = reg.histogram("serve.batch_size", unit="requests",
                                      bounds=BATCH_SIZE_BOUNDS, shared=True)
+        # bag-read accounting (ISSUE 16; schema v12): requests and
+        # pooled vectors delivered, plus which path produced the bits —
+        # fused device gather+pool batches vs host-pooled batches
+        # (replica snapshot hit or flat-union fallback), the replica
+        # subset counted separately for the hit-rate story
+        self.c_bag_lookups = reg.counter("serve.bag_lookups_total",
+                                         shared=True)
+        self.c_bag_pooled = reg.counter("serve.bag_pooled_total",
+                                        shared=True)
+        self.c_bag_fused = reg.counter("serve.bag_fused_total",
+                                       shared=True)
+        self.c_bag_hostpool = reg.counter("serve.bag_hostpool_total",
+                                          shared=True)
+        self.c_bag_replica_hits = reg.counter(
+            "serve.bag_replica_hits_total", shared=True)
 
     def replica_hit_rate(self) -> float:
         """Fraction of coalesced batches served from the read-only
@@ -292,6 +309,30 @@ class LookupBatcher:
         # coalesced lookup starts (flight.batch -> flight.program edge)
         self.c_batches.inc()
         self.h_batch.observe(float(len(reqs)))
+        # bag reads (ISSUE 16) coalesce separately: their reply is
+        # pooled vectors, not per-key rows, so they cannot share the
+        # flat union scatter below. A failed bag batch fails only its
+        # own waiters; the flat requests still get served.
+        bag_reqs = [r for r in reqs if isinstance(r, BagLookupRequest)]
+        if bag_reqs:
+            try:
+                self._serve_bag_batch(bag_reqs, fl, t_dispatch)
+            except (KeyboardInterrupt, SystemExit):
+                for r in reqs:
+                    if not r._done.is_set():
+                        r.fail(RuntimeError(
+                            "serve dispatcher interrupted "
+                            "(KeyboardInterrupt/SystemExit): claimed "
+                            "batch shed"))
+                raise
+            except BaseException as e:  # noqa: BLE001 — see _drain
+                for r in bag_reqs:
+                    if not r._done.is_set():
+                        r.fail(e)
+            reqs = [r for r in reqs
+                    if not isinstance(r, BagLookupRequest)]
+            if not reqs:
+                return
         if len(reqs) == 1:
             allk = reqs[0].keys
         else:
@@ -395,3 +436,137 @@ class LookupBatcher:
                 t_enqueued = time.perf_counter()
             return (srv._assemble_flat(keys, groups, remote=remote),
                     t_enqueued)
+
+    # -- bag reads (ISSUE 16) ------------------------------------------------
+
+    def _serve_bag_batch(self, reqs: List[BagLookupRequest], fl,
+                         t_dispatch: float) -> None:
+        """Serve a coalesced batch of bag lookups. Path choice per
+        batch (serve/bags.py module docstring — the returned bits are
+        identical on every path):
+
+          1. replica snapshot fully covers the member-key union and no
+             `after` ordering → host-pool over the snapshot rows
+             (lock-free, zero device dispatches);
+          2. `--sys.serve.bags` on and single-process (every member is
+             one gather away in the global pools) → ONE fused
+             gather_pool program per (length class, pooling) under the
+             server lock — only pooled vectors cross the device
+             boundary;
+          3. otherwise (multi-process — members may live off-process —
+             or the knob is off) → the flat union gather
+             (`_lookup_union`, which orders remote members through the
+             DCN channel correctly) + host pool."""
+        srv = self.server
+        allk = np.concatenate([r.keys for r in reqs]) \
+            if len(reqs) > 1 else reqs[0].keys
+        union = np.unique(allk)
+        if srv.tier is not None:
+            srv.tier.note_serve(union)
+        after = tuple(f for r in reqs for f in r.after)
+        groups, slices = plan_bag_batch(reqs, srv.ab.key_class)
+        pooled = None
+        rep = self.replica
+        served = rep.try_serve(union) \
+            if rep is not None and not after else None
+        if served is not None:
+            flat, t_cutoff = served
+            self.c_bag_replica_hits.inc()
+            self.c_bag_hostpool.inc()
+            pooled = self._pool_from_flat(flat, union, groups)
+            t_enqueued = t_dispatch
+        else:
+            fused = (bool(getattr(self.opts, "serve_bags", True))
+                     and srv.glob is None and not after)
+            costs = getattr(srv, "costs", None)
+            if fused and costs is not None:
+                # measured-cost consult (ops/costs.py): host-pool this
+                # batch only if the table measures the flat gather +
+                # host pool cheaper for EVERY group's shape; a missing
+                # entry (None) keeps the fused default for its group
+                verdicts = [costs.prefer_fused(
+                    int(srv.value_lengths[g["keys"][0]]),
+                    len(g["keys"]),
+                    np.dtype(srv.stores[gkey[0]].dtype).name,
+                    gkey[1]) for gkey, g in groups.items()]
+                if verdicts and all(v is False for v in verdicts):
+                    fused = False
+                    costs.c_overrides.inc()
+            if fused:
+                dev, t_enqueued = self._lookup_bags_fused(groups)
+                pooled = {k: np.asarray(v)[:groups[k]["nbags"]]
+                          for k, v in dev.items()}
+                t_cutoff = t_enqueued
+                self.c_bag_fused.inc()
+            else:
+                flat, t_enqueued = self._lookup_union(union, after)
+                t_cutoff = t_enqueued
+                self.c_bag_hostpool.inc()
+                pooled = self._pool_from_flat(flat, union, groups)
+        now = time.perf_counter()
+        if fl is not None:
+            fl.record_serve_batch(
+                [r.trace for r in reqs if r.trace is not None],
+                t_dispatch, t_enqueued, now, n_requests=len(reqs),
+                n_keys=len(allk), n_unique=len(union))
+            fl.freshness.note_read(union, t_cutoff)
+        for r, rs in zip(reqs, slices):
+            parts = [np.ascontiguousarray(
+                pooled[g][s:s + nb]).ravel() for g, s, nb in rs]
+            if r.trace is not None:
+                r.trace.t_deliver = time.perf_counter()
+            r.deliver(np.concatenate(parts)
+                      if len(parts) > 1 else parts[0])
+            self.c_bag_lookups.inc()
+            self.c_bag_pooled.inc(sum(nb for _, _, nb in rs))
+            if r.tenant is not None:
+                r.tenant.c_served.inc()
+            self.h_latency.observe(now - r.t0)
+
+    def _lookup_bags_fused(self, groups):
+        """Dispatch one fused gather_pool per (length class, pooling)
+        group — route the member coordinates and enqueue every group's
+        program back-to-back under ONE dispatch-gate hold inside the
+        server lock (the same contiguous-enqueue discipline
+        `Server._pull` applies to multi-class flat batches). Only
+        called single-process (`srv.glob is None`), where every member
+        row lives in the global pools. Returns `({gkey: device pooled
+        matrix}, t_enqueued)` — readback happens on the caller, outside
+        the lock."""
+        srv = self.server
+        from ..core.store import OOB
+        with srv._span("serve.bag_lookup"):
+            with srv._lock:
+                dev = {}
+                with dispatch_gate():
+                    for gkey, g in groups.items():
+                        cid, pooling = gkey
+                        o_sh, o_sl, c_sh, c_sl, use_c, _, _ = \
+                            srv._route(g["keys"], self.shard,
+                                       record=False)
+                        o_sl = np.where(use_c, OOB,
+                                        o_sl).astype(np.int32)
+                        dev[gkey] = srv.stores[cid].gather_pool(
+                            o_sh, o_sl, c_sh, c_sl, use_c, g["seg"],
+                            g["nbags"], pooling=pooling)
+                t_enqueued = time.perf_counter()
+        return dev, t_enqueued
+
+    def _pool_from_flat(self, flat, union, groups):
+        """Host-pool each group's bags out of a flat union value buffer
+        (replica snapshot rows or a `_lookup_union` result) — the
+        bit-identical twin of the fused device path (pool_bags_host)."""
+        srv = self.server
+        from ..parallel.pm import _offsets, _select_flat
+        lens_u = srv.value_lengths[union]
+        offs_u = _offsets(lens_u)
+        out = {}
+        for gkey, g in groups.items():
+            ks = g["keys"]
+            pos = np.searchsorted(union, ks)
+            L = int(srv.value_lengths[ks[0]])
+            rows = _select_flat(flat, offs_u, lens_u,
+                                pos).reshape(len(ks), L)
+            out[gkey] = pool_bags_host(rows, g["seg"], g["nbags"],
+                                       gkey[1])
+        return out

@@ -132,6 +132,31 @@ class ServeSession:
                             trace=tr, tenant=self.tenant,
                             priority=self.priority,
                             lane=self.plane.batcher.assign_lane(keys))
+        flat = self._submit_and_wait(req, deadline_s, deadline_ms,
+                                     fl, tr)
+        if out is not None:
+            # reshape(-1) on a non-contiguous view would COPY and the
+            # caller's buffer would silently stay unfilled; a too-small
+            # buffer would fail with an opaque broadcast error
+            if not out.flags["C_CONTIGUOUS"]:
+                raise ValueError(
+                    "lookup out= buffer must be C-contiguous (got a "
+                    "strided view; pass np.ascontiguousarray(out))")
+            if out.size < len(flat):
+                raise ValueError(
+                    f"lookup out= buffer too small: {out.size} < "
+                    f"{len(flat)} values for this key batch")
+            np.copyto(out.reshape(-1)[: len(flat)], flat)
+        if len(np.unique(lens)) == 1:
+            return flat.reshape(len(keys), int(lens[0]))
+        return flat
+
+    def _submit_and_wait(self, req, deadline_s, deadline_ms, fl, tr):
+        """The submit/wait/shed/grace dance shared by `lookup` and
+        `lookup_bags`: submit into the admission queue, wait out the
+        deadline, shed if still unclaimed, bounded grace if claimed.
+        Returns the delivered flat result; closes the flight trace on
+        any failure so no trace dangles."""
         try:
             self.plane.queue.submit(req)  # may raise ServeOverloadError
             if not req.wait(deadline_s):
@@ -162,19 +187,93 @@ class ServeSession:
             raise
         if fl is not None:
             fl.finish_lookup(tr, ok=True)
-        if out is not None:
-            # reshape(-1) on a non-contiguous view would COPY and the
-            # caller's buffer would silently stay unfilled; a too-small
-            # buffer would fail with an opaque broadcast error
-            if not out.flags["C_CONTIGUOUS"]:
-                raise ValueError(
-                    "lookup out= buffer must be C-contiguous (got a "
-                    "strided view; pass np.ascontiguousarray(out))")
-            if out.size < len(flat):
-                raise ValueError(
-                    f"lookup out= buffer too small: {out.size} < "
-                    f"{len(flat)} values for this key batch")
-            np.copyto(out.reshape(-1)[: len(flat)], flat)
-        if len(np.unique(lens)) == 1:
-            return flat.reshape(len(keys), int(lens[0]))
         return flat
+
+    def lookup_bags(self, tables, bags, pooling: str = "sum",
+                    deadline_ms: Optional[float] = None):
+        """Fused embedding-bag read (ISSUE 16): for each table `t`,
+        `bags[t]` is a non-decreasing offsets array `[0, ..., n_t]`
+        partitioning that table's member keys `tables[t]` into bags;
+        the reply is one `[n_bags_t, L_t]` matrix of `pooling`-pooled
+        ("sum" or "mean") vectors per table — only the POOLED vectors
+        cross the device boundary on the fused path (one gather+pool
+        program per length class), and every serving path returns
+        bit-identical values to host-pooling `lookup` of the same
+        member keys (serve/bags.py docstring; empty bags pool to
+        zeros). Each table's members must share one length class —
+        split mixed-length features into separate tables. Duplicated
+        members accumulate per position, like an embedding bag.
+
+        Same admission/deadline/error semantics as `lookup`."""
+        if pooling not in ("sum", "mean"):
+            raise ValueError("lookup_bags pooling must be 'sum' or "
+                             f"'mean' (got {pooling!r})")
+        if not len(tables) or len(tables) != len(bags):
+            raise ValueError(
+                "lookup_bags needs parallel, non-empty tables/bags "
+                f"lists (got {len(tables)} tables, {len(bags)} bag "
+                "offset arrays)")
+        srv = self.server
+        from ..base import check_key_range
+        tks, tbg, lens_t = [], [], []
+        for t, (ks, bg) in enumerate(zip(tables, bags)):
+            ks = np.ascontiguousarray(
+                np.asarray(ks, dtype=np.int64).ravel())
+            bg = np.asarray(bg, dtype=np.int64).ravel()
+            if len(ks) == 0:
+                raise ValueError(
+                    f"lookup_bags table {t}: needs >= 1 member key "
+                    "(an all-empty table has no length class to pool "
+                    "in)")
+            if (len(bg) < 2 or bg[0] != 0 or bg[-1] != len(ks)
+                    or np.any(np.diff(bg) < 0)):
+                raise ValueError(
+                    f"lookup_bags table {t}: bags must be "
+                    "non-decreasing offsets starting at 0 and ending "
+                    f"at n_members={len(ks)} (got {bg!r})")
+            check_key_range(ks, srv.num_keys)
+            if len(np.unique(srv.ab.key_class[ks])) != 1:
+                raise ValueError(
+                    f"lookup_bags table {t}: member keys span multiple "
+                    "length classes — a pooled vector needs one row "
+                    "width; split mixed-length features into separate "
+                    "tables")
+            tks.append(ks)
+            tbg.append(bg)
+            lens_t.append(int(srv.value_lengths[ks[0]]))
+        reason = srv._degraded_reason
+        if reason is not None:
+            self.plane.queue.c_degraded.inc()
+            raise ServeDegradedError(
+                f"serve degraded: {reason} — lookup_bags shed (retry "
+                f"once readiness recovers; docs/failure_handling.md)")
+        allk = np.concatenate(tks) if len(tks) > 1 else tks[0]
+        if deadline_ms is None:
+            deadline_ms = self.plane.opts.serve_deadline_ms
+        wt = srv.wtrace
+        if wt is not None:
+            # the serve half of the op stream sees the MEMBER keys —
+            # replay reproduces the same union/access pattern
+            wt.record_serve(
+                allk,
+                self.tenant.name if self.tenant is not None else None,
+                self.priority, deadline_ms or 0.0)
+        deadline_s = None if not deadline_ms else deadline_ms * 1e-3
+        after = ()
+        if self.worker is not None and srv.glob is not None:
+            after = tuple(self.worker._live_write_futs())
+        fl = srv.flight
+        tr = fl.mint() if fl is not None else None
+        from .bags import BagLookupRequest
+        req = BagLookupRequest(
+            tks, tbg, pooling, allk, after=after, deadline_s=deadline_s,
+            trace=tr, tenant=self.tenant, priority=self.priority,
+            lane=self.plane.batcher.assign_lane(allk))
+        flat = self._submit_and_wait(req, deadline_s, deadline_ms,
+                                     fl, tr)
+        out, off = [], 0
+        for bg, L in zip(tbg, lens_t):
+            nb = len(bg) - 1
+            out.append(flat[off:off + nb * L].reshape(nb, L))
+            off += nb * L
+        return out

@@ -38,7 +38,7 @@ import time
 
 import numpy as np
 
-from ..core.store import OOB, pad_bucket
+from ..core.store import OOB, pad_bucket, pad_to
 
 # ---------------------------------------------------------------------------
 # residency resolution
@@ -125,6 +125,56 @@ def gather_tiered(store, o_shard, o_slot, c_shard, c_slot, use_cache):
     if store.tier_hist is not None:
         store.tier_hist.observe(time.perf_counter() - t0)
     return out
+
+
+def gather_pool_tiered(store, o_shard, o_slot, c_shard, c_slot,
+                       use_cache, seg, out, pooling):
+    """`gather_tiered`'s fused-bag twin (ISSUE 16): identical residency
+    resolution and cold-row staging, but the member rows reduce into
+    `out` inside the port program (`gather_pool_cold[_wire]`) instead
+    of coming back raw. The pooled result is bit-identical to host-
+    pooling `gather_tiered`'s rows — the gather half is the same
+    program body, and the segment sum accumulates in batch order on
+    both sides."""
+    o_sh = np.asarray(o_shard, dtype=np.int64).ravel()
+    o_sl = np.asarray(o_slot, dtype=np.int64).ravel()
+    g_row, cold, valid = split_owner(store, o_sh, o_sl)
+    _note_access(store, o_sh, o_sl, cold, valid)
+    n = len(o_sh)
+    a = pad_bucket(n, (o_sh.astype(np.int32), 0), (g_row, OOB),
+                   (c_shard, 0), (c_slot, OOB), (use_cache, False),
+                   minimum=store.bucket_min)
+    b = a[0].shape[0]
+    segb = pad_to(np.asarray(seg, dtype=np.int32), b, OOB)
+    if not cold.any():
+        return store.port.gather_pool(store.main, store.cache,
+                                      store.delta, *a, segb, out,
+                                      pooling=pooling)
+    t0 = time.perf_counter()
+    use_cold = np.zeros(b, dtype=bool)
+    use_cold[:n] = cold
+    mode = store.coldq.mode
+    if mode == "fp32":
+        cold_vals = np.zeros((b, store.value_length),
+                             dtype=np.dtype(store.dtype))
+        cold_vals[:n][cold] = store.coldq.read(o_sh[cold], o_sl[cold])
+        pooled = store.port.gather_pool_cold(
+            store.main, store.cache, store.delta, *a, cold_vals,
+            use_cold, segb, out, pooling=pooling)
+    else:
+        q, s = store.coldq.wire(o_sh[cold], o_sl[cold])
+        qbuf = np.zeros((b, store.value_length), dtype=q.dtype)
+        qbuf[:n][cold] = q
+        sbuf = None
+        if mode != "fp16":
+            sbuf = np.zeros(b, dtype=np.float32)
+            sbuf[:n][cold] = s
+        pooled = store.port.gather_pool_cold_wire(
+            mode, store.main, store.cache, store.delta, *a,
+            qbuf, sbuf, use_cold, segb, out, pooling=pooling)
+    if store.tier_hist is not None:
+        store.tier_hist.observe(time.perf_counter() - t0)
+    return pooled
 
 
 def scatter_add_tiered(store, o_shard, o_slot, d_shard, d_slot, vals):

@@ -848,6 +848,160 @@ def bench_serve(E=20_000, vlen=32, clients=32, lookups_per_client=40,
     return out
 
 
+def bench_bag(E=200_000, L=128, nbags=256, members_per_bag=32, rounds=30,
+              tables=2):
+    """Fused embedding-bag read phase (ISSUE 16): the DLRM/Criteo read
+    shape — each request asks for `nbags` POOLED bags (sum over
+    `members_per_bag` member rows each, split across `tables` feature
+    tables of one length class) — timed three ways over the SAME bag
+    workload:
+
+      fused       ServeSession.lookup_bags with the fused gather+pool
+                  device program (one segment-sum gather per length
+                  class, pooled rows on the wire);
+      hostpool    the same lookup_bags calls with --sys.serve.bags off:
+                  the batcher gathers the member union flat and pools
+                  on the host (the bit-identity reference path);
+      sequential  the pre-bag API: one plain `lookup` per table, pooled
+                  by the caller — what a client had to do before
+                  serve/bags.py existed.
+
+    All three must return bit-identical pooled rows (asserted on the
+    first round). The artifact carries qps + P50/P99 per variant, the
+    fused/hostpool median ratio (scripts/portdiff_check.py gates it —
+    < 0.9 on accelerator backends, where the fused program's wire-byte
+    saving (nbags*L pooled rows vs n*L member rows) is real transfer;
+    a host-CPU multiplex memcpy can't see that saving, so CPU runs
+    report near-parity and the guard relaxes accordingly), the
+    serve.bag_* counters, and a measured kernel cost table calibrated
+    on the live server (ops/costs.py) including its fused-vs-host
+    verdict at this workload's shape — the per-backend measurement
+    that lets dispatch pick the cheaper path instead of guessing."""
+    import adapm_tpu
+    from adapm_tpu.config import SystemOptions
+    from adapm_tpu.ops.costs import calibrate_server
+    from adapm_tpu.serve import ServePlane
+    from adapm_tpu.serve.bags import pool_bags_host
+
+    n_members = nbags * members_per_bag
+    _progress(f"bag phase: building server ({E} keys x {L}, "
+              f"{nbags} bags x {members_per_bag} members, "
+              f"{tables} tables)")
+    srv = adapm_tpu.setup(E, L,
+                          opts=SystemOptions(sync_max_per_sec=0,
+                                             prefetch=False))
+    w = srv.make_worker(0)
+    rng = np.random.default_rng(0)
+    slab = 25_000
+    for lo in range(0, E, slab):
+        hi = min(lo + slab, E)
+        w.set(np.arange(lo, hi),
+              rng.normal(size=(hi - lo, L)).astype(np.float32))
+    srv.block()
+
+    # per-round bag workloads, split evenly across `tables` tables of
+    # one length class (the fused path coalesces them into ONE
+    # segment-sum gather; the sequential baseline pays one lookup per
+    # table). Members are uniform over a LARGE vocab — the DLRM shape:
+    # sparse-feature tables are huge, so a batch's members barely
+    # dedup, which is exactly when pool-on-device pays (a tiny vocab
+    # would let the host path shrink its gather via the union dedup)
+    nb_t = nbags // tables
+    mem_t = nb_t * members_per_bag
+    bg_t = np.arange(0, mem_t + 1, members_per_bag)
+    work = [[rng.integers(0, E, mem_t) for _ in range(tables)]
+            for _ in range(rounds)]
+
+    plane = ServePlane(srv)
+    sess = plane.session()
+
+    def run_bags(tks):
+        return sess.lookup_bags(tks, [bg_t] * tables, pooling="sum")
+
+    def run_sequential(tks):
+        out = []
+        for ks in tks:
+            rows = sess.lookup(ks)
+            out.append(pool_bags_host(rows,
+                                      np.repeat(np.arange(nb_t),
+                                                members_per_bag),
+                                      nb_t, "sum"))
+        return out
+
+    def timed(fn):
+        lats = []
+        t0 = time.perf_counter()
+        for tks in work:
+            t1 = time.perf_counter()
+            fn(tks)
+            lats.append(time.perf_counter() - t1)
+        wall = time.perf_counter() - t0
+        lats.sort()
+        return {"qps": round(rounds / wall, 1),
+                "p50_ms": round(1e3 * lats[len(lats) // 2], 3),
+                "p99_ms": round(
+                    1e3 * lats[max(0, int(0.99 * len(lats)) - 1)], 3),
+                "median_s": lats[len(lats) // 2]}
+
+    # warm every path (gather bucket compiles) + the bit-identity check:
+    # fused == host pool == caller pool, bitwise, on round 0
+    ref_fused = run_bags(work[0])
+    srv.opts.serve_bags = False
+    ref_host = run_bags(work[0])
+    srv.opts.serve_bags = True
+    ref_seq = run_sequential(work[0])
+    for a, b, c in zip(ref_fused, ref_host, ref_seq):
+        assert np.array_equal(a, b), "fused != host pool (bitwise)"
+        assert np.array_equal(a, c), "fused != sequential pool (bitwise)"
+
+    _progress("bag phase: fused segment")
+    fused = timed(run_bags)
+    _progress("bag phase: hostpool segment")
+    srv.opts.serve_bags = False
+    hostpool = timed(run_bags)
+    srv.opts.serve_bags = True
+    _progress("bag phase: sequential segment")
+    sequential = timed(run_sequential)
+
+    snap = srv.metrics_snapshot()["serve"]
+    bag_counters = {k: v for k, v in snap.items()
+                    if k.startswith("bag_")}
+    plane.close()
+
+    # measured kernel cost table on the live server, calibrated at the
+    # workload's padded member count next to a small bucket — the
+    # dispatch verdict the batcher would consult with --sys.costs.table
+    _progress("bag phase: calibrating cost table")
+    costs = calibrate_server(srv, buckets=(512, n_members), repeats=3)
+    verdict = costs.prefer_fused(L, n_members, "float32", "sum")
+    ratio = round(fused["median_s"] / hostpool["median_s"], 3)
+    for d in (fused, hostpool, sequential):
+        del d["median_s"]
+    _progress(f"bag phase: fused {fused['qps']} qps vs hostpool "
+              f"{hostpool['qps']} vs sequential {sequential['qps']}; "
+              f"median ratio {ratio}, cost-table verdict "
+              f"prefer_fused={verdict}")
+    out = {"bags_per_lookup": nbags,
+           "members_per_bag": members_per_bag,
+           "value_length": L,
+           "tables": tables,
+           "lookups": rounds,
+           "fused": fused,
+           "hostpool": hostpool,
+           "sequential": sequential,
+           # medians, fused/hostpool: < 1 means the fused program beats
+           # gather-then-host-pool on this backend at this shape
+           "fused_vs_hostpool": ratio,
+           "seq_gain": round(sequential["p50_ms"] / fused["p50_ms"],
+                             3),
+           "bag_metrics": bag_counters,
+           "cost_table": {"backend": costs.backend,
+                          "entries": costs.entries(),
+                          "prefer_fused_at_workload": verdict}}
+    srv.shutdown()
+    return out
+
+
 def bench_replay(E=8_000, vlen=16, steps=120, skew=8.0):
     """Trace-replay phase (ISSUE 15): capture a zipf pull/push/serve
     workload once (--sys.trace.workload), then score a hot-capacity
@@ -1519,6 +1673,17 @@ def _phase_serve():
     return out
 
 
+def _phase_bag():
+    import jax
+    sz = {"E": 6_000, "L": 64, "nbags": 64, "rounds": 10} \
+        if os.environ.get("ADAPM_BENCH_SMALL") else {}
+    out = bench_bag(**sz)
+    out["virtual_shards"] = len(jax.devices("cpu"))
+    if sz:
+        out["small_sizes"] = sz
+    return out
+
+
 def _phase_tier():
     import jax
     sz = {"E": 10_000, "B": 512, "steps": 30, "warmup": 12} \
@@ -1604,6 +1769,7 @@ _PHASES = {"probe": _phase_probe, "kge": _phase_kge,
            "prefetch": _phase_prefetch, "scan": _phase_scan,
            "dedup": _phase_dedup, "pm": _phase_pm, "mgmt": _phase_mgmt,
            "compress": _phase_compress, "serve": _phase_serve,
+           "bag": _phase_bag,
            "tier": _phase_tier, "exec": _phase_exec,
            "episodic": _phase_episodic,
            "fault": _phase_fault, "replay": _phase_replay,
@@ -1613,7 +1779,8 @@ _PHASES = {"probe": _phase_probe, "kge": _phase_kge,
 # these; a wedged relay burns one wall once, then the driver degrades
 _TIMEOUTS = {"probe": 120, "kge": 1200, "prefetch": 1200, "scan": 900,
              "dedup": 900, "pm": 900, "mgmt": 900, "compress": 900,
-             "serve": 900, "tier": 900, "exec": 900, "episodic": 900,
+             "serve": 900, "bag": 900, "tier": 900, "exec": 900,
+             "episodic": 900,
              "fault": 900, "replay": 900, "w2v": 900, "cpu": 600}
 
 _CPU_ENV = {"JAX_PLATFORMS": "cpu", "ADAPM_PLATFORM": "cpu",
@@ -1748,6 +1915,11 @@ def main():
     # and admission queue are host-side, and the comparison against
     # sequential per-request pulls needs both paths on the same backend
     results["serve"] = _run_phase("serve", pm_env)
+    # fused bag-read phase (ISSUE 16): host-CPU by design — the
+    # fused-vs-hostpool-vs-sequential comparison needs all three read
+    # paths on the same backend, and the cost table it calibrates is
+    # only meaningful for the backend that measured it
+    results["bag"] = _run_phase("bag", pm_env)
     # tiered-storage phase (ISSUE 5): host-CPU by design — the
     # untiered-vs-tiered comparison needs both configurations on the
     # same backend, and the cold path's cost is host<->device traffic

@@ -18,9 +18,12 @@ the logistic loss, and push additive AdaGrad deltas.
 
 After training, the INFERENCE half serves the same model through the
 online serving plane (adapm_tpu/serve; docs/SERVING.md): several client
-threads score held-out samples via coalesced `ServeSession.lookup` calls
-— the end-to-end train-then-serve shape of a production CTR system —
-and the predictions are checked bit-identical against the training-path
+threads score held-out samples, fetching the FM's per-sample feature
+SUMS via fused `ServeSession.lookup_bags` reads (one bag per sample
+over its FIELDS keys — the DLRM embedding-bag shape) next to a flat
+`lookup` for the quadratic term's squared member rows — the end-to-end
+train-then-serve shape of a production CTR system — and both reads are
+checked bit-identical against each other and against the training-path
 pull (the serving plane's consistency contract).
 
 Run: PYTHONPATH=. python examples/ctr_example.py
@@ -110,8 +113,20 @@ def serve_inference(server, feats, clicks, n_clients=4, batch=32,
     held-out samples through coalesced lookups (concurrent clients hit
     the same hot feature rows — the micro-batcher deduplicates the
     union), with a generous per-request deadline so an overloaded box
-    sheds instead of hanging."""
+    sheds instead of hanging.
+
+    The FM's linear term and factor sum are BAG reads — each sample is
+    one bag over its FIELDS feature keys, and `lookup_bags` returns the
+    sum-pooled [sum w | sum v | sum acc] row per sample straight from
+    the fused gather+pool program (docs/SERVING.md "Bag reads"), so the
+    per-member rows never cross the wire. The quadratic term needs
+    sum_i v_i^2 — a sum of SQUARED member rows, which no linear pooling
+    can produce — so the squared correction still rides a flat `lookup`
+    of the batch's unique keys; that flat read doubles as the
+    bit-identity witness: host-pooling it must reproduce the bag read
+    exactly (the serve/bags.py contract)."""
     from adapm_tpu.serve import ServePlane
+    from adapm_tpu.serve.bags import pool_bags_host
 
     plane = ServePlane(server._srv)  # knobs from --sys.serve.* defaults
     held = np.arange(samples)
@@ -119,20 +134,32 @@ def serve_inference(server, feats, clicks, n_clients=4, batch=32,
     preds = [None] * n_clients
     rows_seen = [None] * n_clients
 
-    def fm_score(rows: np.ndarray, inv: np.ndarray) -> np.ndarray:
-        w = rows[:, 0][inv]
-        v = rows[:, 1:1 + DIM][inv]
-        return w.sum(1) + 0.5 * ((v.sum(1) ** 2
-                                  - (v ** 2).sum(1)).sum(1))
-
     def client(ci):
         sess = plane.session()
         out, seen = [], {}
         for lo in range(0, len(parts[ci]), batch):
             idx = parts[ci][lo:lo + batch]
-            uniq, inv = np.unique(feats[idx], return_inverse=True)
+            fk = feats[idx]                      # [b, FIELDS]
+            b = len(idx)
+            ks = fk.ravel().astype(np.int64)
+            bg = np.arange(0, len(ks) + 1, FIELDS)
+            # one bag per sample: sum-pooled [w|v|acc] rows off the wire
+            (pooled,) = sess.lookup_bags([ks], [bg], pooling="sum",
+                                         deadline_ms=10_000)
+            # flat read for the quadratic term's squared member rows
+            uniq, inv = np.unique(fk, return_inverse=True)
+            inv = inv.reshape(-1)   # numpy >= 2.1 returns fk's 2-D shape
             rows = sess.lookup(uniq, deadline_ms=10_000)
-            out.append(fm_score(rows, inv.reshape(len(idx), FIELDS)))
+            host = pool_bags_host(rows[inv],
+                                  np.repeat(np.arange(b), FIELDS)
+                                  .astype(np.int32), b, "sum")
+            assert np.array_equal(pooled, host), \
+                "bag read diverged from host pool of the flat read"
+            sw = pooled[:, 0]                    # sum_i w_i
+            sv = pooled[:, 1:1 + DIM]            # sum_i v_i
+            v = rows[:, 1:1 + DIM][inv.reshape(b, FIELDS)]
+            out.append(sw + 0.5 * ((sv ** 2).sum(1)
+                                   - (v ** 2).sum((1, 2))))
             for k, r in zip(uniq, rows):
                 seen[int(k)] = r
         preds[ci] = np.concatenate(out)
@@ -164,7 +191,10 @@ def serve_inference(server, feats, clicks, n_clients=4, batch=32,
     snap = server._srv.metrics_snapshot()["serve"]
     print(f"serve: {len(held)} samples via {n_clients} clients, "
           f"logloss {logloss:.3f}, {snap['batches_total']} coalesced "
-          f"batches for {snap['lookups_total']} lookups, "
+          f"batches for {snap['lookups_total']} lookups + "
+          f"{snap['bag_lookups_total']} bag lookups "
+          f"({snap['bag_pooled_total']} pooled bags, "
+          f"{snap['bag_fused_total']} fused), "
           f"ready={bool(snap['ready'])}")
     plane.close()
     return logloss

@@ -73,5 +73,14 @@ python scripts/trace_replay_check.py
 # (never a torn or stale read); and a 1%-dirty trickle's delta link
 # must cost <= 10% of a full checkpoint
 python scripts/fault_drill_check.py
+# port-differential + fused-bag guard (ISSUE 16): the same seeded
+# 5-plane storm run against the jax DevicePort and the pure-NumPy
+# reference port must read bit-identically (plus a deterministic
+# fp16/int8 wire-program differential on standalone tiered stores);
+# device/refport.py must stay jax-free with zero lint suppressions;
+# and the fused gather_pool bag read must beat gather-then-host-pool
+# (median pairwise, < 0.9 on accelerators; near-parity bar on CPU
+# hosts where the wire-byte saving is a memcpy — ADAPM_BAG_RATIO_MAX)
+python scripts/portdiff_check.py
 python -m pytest tests/ -q "$@"
 echo "ALL TESTS PASSED"

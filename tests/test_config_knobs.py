@@ -418,3 +418,45 @@ def test_workload_trace_knobs_round_trip_and_rejection():
     # non-integer budget rejected by argparse itself
     with pytest.raises(SystemExit):
         p.parse_args(["--sys.trace.workload_keys", "lots"])
+
+
+def test_bag_and_costs_knobs_round_trip_and_rejection():
+    """--sys.serve.bags / --sys.costs.table / --sys.costs.calibrate
+    (ISSUE 16): parse into the options the serve batcher's bag
+    dispatch and the kernel cost table consume; bags default ON (the
+    fused path), the cost table defaults absent; an empty table path
+    and a calibrate without a table are rejected at parse time AND on
+    hand-built options."""
+    import argparse
+
+    import pytest
+
+    from adapm_tpu.config import SystemOptions
+    p = argparse.ArgumentParser()
+    SystemOptions.add_arguments(p)
+    dflt = SystemOptions.from_args(p.parse_args([]))
+    assert dflt.serve_bags is True
+    assert dflt.costs_table is None
+    assert dflt.costs_calibrate is False
+    on = SystemOptions.from_args(p.parse_args(
+        ["--sys.serve.bags", "0",
+         "--sys.costs.table", "/tmp/costs.json",
+         "--sys.costs.calibrate", "1"]))
+    assert on.serve_bags is False
+    assert on.costs_table == "/tmp/costs.json"
+    assert on.costs_calibrate is True
+    # an empty table path can persist nothing — rejected loudly
+    with pytest.raises(ValueError, match="costs.table"):
+        SystemOptions.from_args(p.parse_args(
+            ["--sys.costs.table", ""]))
+    with pytest.raises(ValueError, match="costs.table"):
+        SystemOptions(costs_table="").validate_serve()
+    # a calibration pass with nowhere to persist is a no-op trap
+    with pytest.raises(ValueError, match="costs.calibrate"):
+        SystemOptions.from_args(p.parse_args(
+            ["--sys.costs.calibrate", "1"]))
+    with pytest.raises(ValueError, match="costs.calibrate"):
+        SystemOptions(costs_calibrate=True).validate_serve()
+    # non-integer bag flag rejected by argparse itself
+    with pytest.raises(SystemExit):
+        p.parse_args(["--sys.serve.bags", "maybe"])

@@ -223,6 +223,26 @@ class SystemOptions:
     # keys; larger batches record an evenly-strided sample + the true
     # count, loudly (wtrace.sampled_batches_total)
     trace_workload_keys: int = 4096
+    # decision telemetry capture (ISSUE 17; obs/decisions.py,
+    # docs/OBSERVABILITY.md "Explain a decision"): record every
+    # adaptive decision — relocate-vs-replicate, tier promote/demote
+    # with the anti-thrash verdict, dirty-sync ship/hold, SLO window
+    # moves, prefetch stage/skip, cost-table overrides — with the
+    # feature vector visible at decision time and a bounded follow-up
+    # outcome window, into a versioned, checksummed .dtrace file at
+    # this path (replay/dataset.py exports the labeled join). Default
+    # off (None): Server.decisions is None, every instrumented site
+    # pays one `is None` check, zero decision.* registry names (the r7
+    # skip-wrapper discipline; scripts/metrics_overhead_check.py).
+    trace_decisions: Optional[str] = None
+    # outcome-attribution follow-up window: a decision's outcome probe
+    # resolves after this many same-plane decisions (or 8x any-plane
+    # events, or the recorder's wall deadline, whichever first); >= 1
+    trace_decisions_window: int = 8
+    # span-event buffer bound (obs/spans.py; ISSUE 17 satellite): spans
+    # beyond it are counted loudly in spans.dropped instead of stored.
+    # Validated >= 1000 — a tiny bound would silently gut every trace
+    trace_spans_max_events: int = 1_000_000
 
     # -- online serving plane (sys.serve.*; adapm_tpu/serve,
     #    docs/SERVING.md). Knob ranges are validated by validate_serve()
@@ -447,6 +467,22 @@ class SystemOptions:
             raise ValueError(
                 "--sys.trace.workload needs a non-empty path for the "
                 ".wtrace file (omit the flag to disable capture)")
+        if self.trace_decisions is not None and not self.trace_decisions:
+            raise ValueError(
+                "--sys.trace.decisions needs a non-empty path for the "
+                ".dtrace file (omit the flag to disable capture)")
+        if self.trace_decisions_window < 1:
+            raise ValueError(
+                f"--sys.trace.decisions_window must be >= 1 "
+                f"(got {self.trace_decisions_window}): a zero window "
+                f"would close every outcome probe before any follow-up "
+                f"could land — attribution without evidence")
+        if self.trace_spans_max_events < 1000:
+            raise ValueError(
+                f"--sys.trace.spans.max_events must be >= 1000 "
+                f"(got {self.trace_spans_max_events}): a smaller bound "
+                f"would drop nearly every span — an unreadable trace "
+                f"masquerading as a cheap one")
         if self.fault_spec:
             from .fault.inject import parse_fault_spec
             parse_fault_spec(self.fault_spec)  # raises ValueError on a
@@ -578,6 +614,14 @@ class SystemOptions:
         g.add_argument("--sys.trace.workload_keys",
                        dest="sys_trace_workload_keys", type=int,
                        default=4096)
+        g.add_argument("--sys.trace.decisions",
+                       dest="sys_trace_decisions", default=None)
+        g.add_argument("--sys.trace.decisions_window",
+                       dest="sys_trace_decisions_window", type=int,
+                       default=8)
+        g.add_argument("--sys.trace.spans.max_events",
+                       dest="sys_trace_spans_max_events", type=int,
+                       default=1_000_000)
         g.add_argument("--sys.serve.max_batch", dest="sys_serve_max_batch",
                        type=int, default=64)
         g.add_argument("--sys.serve.max_wait_us",
@@ -683,6 +727,9 @@ class SystemOptions:
             trace_flight_out=args.sys_trace_flight_out,
             trace_workload=args.sys_trace_workload,
             trace_workload_keys=args.sys_trace_workload_keys,
+            trace_decisions=args.sys_trace_decisions,
+            trace_decisions_window=args.sys_trace_decisions_window,
+            trace_spans_max_events=args.sys_trace_spans_max_events,
             serve_max_batch=args.sys_serve_max_batch,
             serve_max_wait_us=args.sys_serve_max_wait_us,
             serve_queue=args.sys_serve_queue,

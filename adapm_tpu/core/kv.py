@@ -129,8 +129,10 @@ class Server:
                 bc_path = ring_path = None
         if self.opts.trace_spans:
             from ..obs.spans import SpanTracer
-            self.spans = SpanTracer(rank=self.pid,
-                                    breadcrumb_path=bc_path)
+            self.spans = SpanTracer(
+                rank=self.pid, breadcrumb_path=bc_path,
+                max_events=self.opts.trace_spans_max_events,
+                registry=self.obs)
         # request-flight tracing (ISSUE 7 tentpole; obs/flight.py):
         # per-request causal traces across admission -> batch ->
         # executor program -> reply, exported as Perfetto flow events.
@@ -154,6 +156,19 @@ class Server:
             self.wtrace = WorkloadTraceRecorder(
                 self, self.opts.trace_workload,
                 key_budget=self.opts.trace_workload_keys)
+        # decision telemetry capture (ISSUE 17 tentpole;
+        # obs/decisions.py): every adaptive decision with its
+        # at-decision feature vector + bounded outcome attribution,
+        # recorded to a versioned, checksummed .dtrace file. Default
+        # off — when None every instrumented site pays one `is None`
+        # check (the r7 skip-wrapper discipline) and the registry
+        # holds zero decision.* names.
+        self.decisions = None
+        if self.opts.trace_decisions:
+            from ..obs.decisions import DecisionRecorder
+            self.decisions = DecisionRecorder(
+                self, self.opts.trace_decisions,
+                follow_events=self.opts.trace_decisions_window)
         # populated by a ReplayEngine that drove this server (the
         # snapshot's always-present `replay` section; schema v11)
         self.replay_stats: Optional[Dict] = None
@@ -1185,6 +1200,15 @@ class Server:
             # replay lets the candidate policy re-decide
             wt.record_decision("reloc", n_moved, dest=int(dest),
                                demoted=int(len(demoted)))
+        dc = self.decisions
+        if dc is not None and (n_moved or len(demoted)):
+            # ISSUE 17: the same landed move, with features + a
+            # post-move-locality outcome window over the keys that
+            # actually moved (the deduped batch minus the demotions)
+            moved_keys = np.setdiff1d(keys, demoted) if len(demoted) \
+                else keys
+            dc.record_move(int(dest), n_moved, int(len(demoted)),
+                           moved_keys)
         return n_moved
 
     # -- lifecycle -----------------------------------------------------------
@@ -1486,6 +1510,11 @@ class Server:
             # final flush + seal AFTER every producer is stopped: the
             # .wtrace on disk is the complete recorded stream
             self.wtrace.close()
+        if self.decisions is not None:
+            # same ordering rule, and additionally BEFORE store/pool
+            # teardown below: close() force-resolves the open outcome
+            # windows, whose probes read residency/addressbook state
+            self.decisions.close()
         if self.spans is not None:
             self.spans.close()
         if self.flight_recorder is not None:
@@ -1570,7 +1599,7 @@ class Server:
                           "sync", "pm", "collective", "fused", "spans",
                           "serve", "tier", "exec", "flight", "slo",
                           "fault", "ckpt", "device", "episode",
-                          "wtrace", "replay")
+                          "wtrace", "replay", "decision")
 
     def metrics_snapshot(self, drain_device: bool = True) -> Dict:
         """One structured, JSON-serializable telemetry dict for this
@@ -1691,8 +1720,23 @@ class Server:
         `device.costs_consults_total` / `device.costs_overrides_total`
         / `device.costs_calibrations_total` and the
         `device.costs_entries` gauge, absent until a table is
-        attached."""
-        out: Dict = {"schema_version": 12,
+        attached.
+
+        schema_version 13 (PR 17): always-present `decision` section
+        (ISSUE 17; obs/decisions.py, `--sys.trace.decisions`) — the
+        decision telemetry plane's event/dropped counters, the
+        per-plane regret counters and rates
+        (`decision.promoted_never_hit`,
+        `decision.replicated_never_read`, `decision.shipped_clean`,
+        `decision.regret_rate.<plane>`), and the recorder's
+        window-attribution stats (opened/resolved/forced + per-plane
+        decided/resolved/regretted tallies); `{}` when capture is off
+        (no recorder object, zero decision.* names). The spans section
+        gains `spans.dropped` (registered while a SpanTracer exists):
+        span-buffer overflow drops, counted loudly instead of silently
+        capping at the old hardcoded 1M bound (now
+        `--sys.trace.spans.max_events`)."""
+        out: Dict = {"schema_version": 13,
                      "metrics_enabled": bool(self.obs.enabled)}
         for s in self._SNAPSHOT_SECTIONS:
             out[s] = {}
@@ -1750,6 +1794,8 @@ class Server:
             out["flight"]["recorder"] = self.flight_recorder.summary()
         if self.wtrace is not None:
             out["wtrace"].update(self.wtrace.stats())
+        if self.decisions is not None:
+            out["decision"].update(self.decisions.stats())
         if self.replay_stats is not None:
             out["replay"].update(self.replay_stats)
         if self._serve_plane is not None and \

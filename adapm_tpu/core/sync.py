@@ -463,6 +463,14 @@ class SyncManager:
             if len(cand) == 0:
                 return e, e, remote
         relocate = self._decide_batch(cand, shard)
+        dc = self.server.decisions
+        if dc is not None:
+            # ISSUE 17: the relocate-vs-replicate split with its
+            # feature vector; replications open an outcome window
+            # probing whether the replicas were ever worth creating
+            rep = cand[~relocate]
+            dc.record_classify(int(shard), int(relocate.sum()),
+                               len(rep), len(remote), rep)
         return cand[relocate], cand[~relocate], remote
 
     def _decide_batch(self, keys: np.ndarray, shard: int) -> np.ndarray:
@@ -530,6 +538,7 @@ class SyncManager:
         self.stats.add(keys_considered=len(keep_l) + len(keep_x))
         if len(keep_l):
             kk, ks = keys[keep_l], shards[keep_l]
+            n_considered, n_dirty = len(kk), -1
             if self.opts.sync_dirty_only:
                 # dirty-delta filter: gather-and-ship only replicas with
                 # an unshipped write or a stale base (store.py write
@@ -537,6 +546,7 @@ class SyncManager:
                 # program is a bit-for-bit no-op (delta == 0 and cache
                 # == main), so skipping it cannot change any read.
                 dirty = srv._dirty_replica_mask(kk, ks)
+                n_dirty = int(dirty.sum())
                 if dirty.any() and not dirty.all():
                     # sibling propagation: a dirty replica's merge
                     # advances the shared main row DURING this round, so
@@ -548,6 +558,13 @@ class SyncManager:
                     # channel, so the batch is self-contained.
                     dirty |= np.isin(kk, kk[dirty])
                 kk, ks = kk[dirty], ks[dirty]
+            dc = srv.decisions
+            if dc is not None:
+                # ISSUE 17: the ship/hold verdict for this channel's
+                # batch — clean sibling ride-alongs (or a fully-clean
+                # ship with the dirty filter off) fold into
+                # decision.shipped_clean
+                dc.record_sync(channel, n_considered, n_dirty, len(kk))
             if len(kk):
                 # periodic rounds ship in the --sys.sync.compress wire
                 # format (the EF residual parks in the delta row);

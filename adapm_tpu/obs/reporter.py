@@ -22,6 +22,10 @@ subsystem has activity, always in this order:
     serve=<n> p50=<ms>ms p99=<ms>ms            lookups + latency tail
     overlap=<ratio>                            exec overlap_fraction
     hot_hit=<ratio>                            tier hot-hit rate
+    fresh=<ms>ms                               push-to-servable P99
+                                               (flight.freshness_s)
+    regret=<ratio>                             worst per-plane decision
+                                               regret rate (ISSUE 17)
 
 Ratios are 2-decimal, latencies 2-decimal milliseconds."""
 from __future__ import annotations
@@ -72,6 +76,17 @@ def _fmt(snap: dict) -> str:
     tr = snap.get("tier", {})
     if tr.get("hot_hits", 0) or tr.get("cold_hits", 0):
         parts.append(f"hot_hit={tr.get('hot_hit_rate', 0.0):.2f}")
+    # push-to-servable freshness tail (flight probe) once it has samples
+    fr = snap.get("flight", {}).get("freshness_s")
+    if isinstance(fr, dict) and fr.get("count"):
+        parts.append(f"fresh={hist_percentile(fr, 0.99) * 1e3:.2f}ms")
+    # decision telemetry: the worst per-plane regret rate once any
+    # outcome window resolved (ISSUE 17)
+    dc = snap.get("decision", {})
+    rates = [v for k, v in dc.items() if k.startswith("regret_rate.")
+             and isinstance(v, (int, float))]
+    if dc.get("events_total") and rates:
+        parts.append(f"regret={max(rates):.2f}")
     return " ".join(parts) or "no activity yet"
 
 

@@ -178,6 +178,13 @@ class SLOController:
         if self.first_adjustment is None:
             self.first_adjustment = move
         self.adjustments.append(move)
+        dc = self.server.decisions
+        if dc is not None:
+            # ISSUE 17: the autopilot move with its window/target
+            # features; the outcome probe re-reads the windowed P99
+            # gauge to judge whether the move helped the tail
+            dc.record_serve(cur, new, p99 * 1e3, self.target_s * 1e3,
+                            lambda: float(self.g_p99.value))
 
     # -- reporting -----------------------------------------------------------
 

@@ -17,8 +17,12 @@ checkpoint-restore paths (CHANGES.md r6) — the file names the phase the
 process died inside, complementing the faulthandler stack
 (obs/crash.py).
 
-Memory is bounded: beyond `max_events` spans, new ones are counted as
-dropped instead of stored (the trace states the truncation).
+Memory is bounded: beyond `max_events` spans
+(`--sys.trace.spans.max_events`, validated >= 1000 in config.py), new
+ones are counted as dropped instead of stored — loudly: one warning
+log on the first drop plus the `spans.dropped` registry counter
+(ISSUE 17 satellite; the old behavior capped silently at a hardcoded
+1M), and the exported trace states the truncation.
 """
 from __future__ import annotations
 
@@ -67,10 +71,18 @@ class _Span:
 
 class SpanTracer:
     def __init__(self, rank: int = 0, max_events: int = 1_000_000,
-                 breadcrumb_path: Optional[str] = None):
+                 breadcrumb_path: Optional[str] = None, registry=None):
         self.rank = rank
         self.max_events = max_events
         self.dropped = 0
+        # overflow drops are loud (ISSUE 17 satellite): a registry
+        # counter when the server's registry is live, else the plain
+        # `dropped` tally alone (spans.* names exist only while a
+        # tracer does — the skip-wrapper naming discipline)
+        self._c_dropped = None
+        if registry is not None and registry.enabled:
+            self._c_dropped = registry.counter("spans.dropped")
+        self._warned_drop = False
         # (tid, name, t0_us, dur_us); list.append is atomic under the GIL
         self._events: List[Tuple[int, str, float, float]] = []
         self._t0 = time.perf_counter()
@@ -96,6 +108,16 @@ class SpanTracer:
         t1 = time.perf_counter()
         if len(self._events) >= self.max_events:
             self.dropped += 1
+            if self._c_dropped is not None:
+                self._c_dropped.inc()
+            if not self._warned_drop:
+                self._warned_drop = True
+                from ..utils import alog
+                alog(f"[spans] event buffer full ({self.max_events} "
+                     f"spans; --sys.trace.spans.max_events); further "
+                     f"spans are DROPPED (counted in spans.dropped) — "
+                     f"the exported trace is a loud prefix, not a "
+                     f"silent lie")
             return
         self._events.append((threading.get_ident(), name,
                              (t0 - self._t0) * 1e6, (t1 - t0) * 1e6))

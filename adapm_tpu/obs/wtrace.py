@@ -100,6 +100,77 @@ from ..utils import write_atomic as _write_atomic  # noqa: E402 — the
 # mid-flush leaves the previous file (or nothing), never a torn trace
 
 
+# ---------------------------------------------------------------------------
+# shared trace-file machinery (ISSUE 17): the .dtrace decision trace
+# (obs/decisions.py) writes and verifies through the SAME header/
+# checksum/atomic-rename code path as the .wtrace — one discipline,
+# two formats, zero drift between their corruption guarantees
+# ---------------------------------------------------------------------------
+
+
+def write_trace_file(path: str, doc: Dict, fmt: str,
+                     version: int) -> int:
+    """Serialize `doc` and write it atomically as a one-line JSON
+    header (format, version, body sha256, body byte count) + JSON
+    body. Returns the total bytes written."""
+    body = json.dumps(doc, separators=(",", ":")).encode()
+    header = json.dumps(
+        {"format": fmt, "version": version,
+         "body_sha256": hashlib.sha256(body).hexdigest(),
+         "body_bytes": len(body)}).encode()
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    _write_atomic(path, header + b"\n" + body)
+    return len(header) + 1 + len(body)
+
+
+def load_trace_doc(path: str, fmt: str, version: int, err_cls,
+                   noun: str) -> Dict:
+    """Read + verify one header-lined trace file; returns the parsed
+    body dict. Verification order (format -> version -> length ->
+    sha256) runs BEFORE any parse of the body — a truncated or flipped
+    file raises the caller's named `err_cls`, never a half-parsed
+    trace."""
+    try:
+        with open(path, "rb") as f:
+            raw = f.read()
+    except OSError as e:
+        raise err_cls(f"cannot read {noun} {path!r}: {e}") from e
+    nl = raw.find(b"\n")
+    if nl < 0:
+        raise err_cls(f"{noun} {path!r}: missing header line "
+                      f"(truncated or not a {fmt} file)")
+    try:
+        header = json.loads(raw[:nl])
+    except ValueError as e:
+        raise err_cls(f"{noun} {path!r}: unparseable header: {e}") from e
+    if header.get("format") != fmt:
+        raise err_cls(f"{noun} {path!r}: format "
+                      f"{header.get('format')!r} is not {fmt!r}")
+    if header.get("version") != version:
+        raise err_cls(f"{noun} {path!r}: version "
+                      f"{header.get('version')!r} unsupported (this "
+                      f"build reads v{version})")
+    body = raw[nl + 1:]
+    want_bytes = header.get("body_bytes")
+    if want_bytes != len(body):
+        raise err_cls(f"{noun} {path!r}: body is {len(body)} bytes, "
+                      f"header promised {want_bytes} (truncated "
+                      f"write?)")
+    digest = hashlib.sha256(body).hexdigest()
+    if digest != header.get("body_sha256"):
+        raise err_cls(f"{noun} {path!r}: body sha256 mismatch "
+                      f"(bit flip / partial overwrite) — refusing to "
+                      f"load")
+    try:
+        return json.loads(body)
+    except ValueError as e:
+        raise err_cls(f"{noun} {path!r}: checksummed body failed to "
+                      f"parse ({e}) — file written by an incompatible "
+                      f"recorder?") from e
+
+
 class WorkloadTraceRecorder:
     """One per Server when `--sys.trace.workload` names a path; owned
     and closed by the server (shutdown step 9, after every producer is
@@ -315,18 +386,11 @@ class WorkloadTraceRecorder:
                 doc = {"meta": self._meta(),
                        "events": list(self._events),
                        "dropped": int(self.c_dropped.value)}
-            body = json.dumps(doc, separators=(",", ":")).encode()
-            header = json.dumps(
-                {"format": WTRACE_FORMAT, "version": WTRACE_VERSION,
-                 "body_sha256": hashlib.sha256(body).hexdigest(),
-                 "body_bytes": len(body)}).encode()
-            d = os.path.dirname(self.path)
-            if d:
-                os.makedirs(d, exist_ok=True)
-            _write_atomic(self.path, header + b"\n" + body)
+            nbytes = write_trace_file(self.path, doc, WTRACE_FORMAT,
+                                      WTRACE_VERSION)
             with self._lock:
                 self._flushes += 1
-            self.g_bytes.set(float(len(header) + 1 + len(body)))
+            self.g_bytes.set(float(nbytes))
         return self.path
 
     def close(self) -> None:
@@ -395,47 +459,12 @@ def load_wtrace(path: str) -> WorkloadTrace:
     """Read + verify a `.wtrace` file. Raises `WorkloadTraceError` on a
     missing/truncated/corrupt/incompatible file — named, and BEFORE any
     replay state exists."""
+    doc = load_trace_doc(path, WTRACE_FORMAT, WTRACE_VERSION,
+                         WorkloadTraceError, "workload trace")
     try:
-        with open(path, "rb") as f:
-            raw = f.read()
-    except OSError as e:
-        raise WorkloadTraceError(
-            f"cannot read workload trace {path!r}: {e}") from e
-    nl = raw.find(b"\n")
-    if nl < 0:
-        raise WorkloadTraceError(
-            f"workload trace {path!r}: missing header line "
-            f"(truncated or not a .wtrace file)")
-    try:
-        header = json.loads(raw[:nl])
-    except ValueError as e:
-        raise WorkloadTraceError(
-            f"workload trace {path!r}: unparseable header: {e}") from e
-    if header.get("format") != WTRACE_FORMAT:
-        raise WorkloadTraceError(
-            f"workload trace {path!r}: format "
-            f"{header.get('format')!r} is not {WTRACE_FORMAT!r}")
-    if header.get("version") != WTRACE_VERSION:
-        raise WorkloadTraceError(
-            f"workload trace {path!r}: version "
-            f"{header.get('version')!r} unsupported (this build reads "
-            f"v{WTRACE_VERSION})")
-    body = raw[nl + 1:]
-    want_bytes = header.get("body_bytes")
-    if want_bytes != len(body):
-        raise WorkloadTraceError(
-            f"workload trace {path!r}: body is {len(body)} bytes, "
-            f"header promised {want_bytes} (truncated write?)")
-    digest = hashlib.sha256(body).hexdigest()
-    if digest != header.get("body_sha256"):
-        raise WorkloadTraceError(
-            f"workload trace {path!r}: body sha256 mismatch "
-            f"(bit flip / partial overwrite) — refusing to replay")
-    try:
-        doc = json.loads(body)
         meta = doc["meta"]
         events = doc["events"]
-    except (ValueError, KeyError, TypeError) as e:
+    except (KeyError, TypeError) as e:
         raise WorkloadTraceError(
             f"workload trace {path!r}: checksummed body failed to "
             f"parse ({e}) — file written by an incompatible "

@@ -10,10 +10,18 @@ emits a ranked comparison artifact; docs/REPLAY.md has the
 policy-scoring and capacity-sim recipes, and the determinism contract
 (same trace + same seed + same knobs => bit-identical replayed reads,
 pinned by tests/test_wtrace.py and scripts/trace_replay_check.py).
+
+`dataset.py` (ISSUE 17) joins a capture run's decision trace
+(`--sys.trace.decisions`, obs/decisions.py) against its workload trace
+into the labeled (features, decision, outcome) table the policy lab
+trains and scores against — see docs/REPLAY.md "Policy scoring".
 """
 from __future__ import annotations
 
+from ..obs.decisions import (DecisionTrace,  # noqa: F401
+                             DecisionTraceError, load_dtrace)
 from ..obs.wtrace import (WorkloadTrace, WorkloadTraceError,  # noqa: F401
                           load_wtrace)
+from .dataset import dataset_bytes, export_dataset  # noqa: F401
 from .engine import (OBJECTIVES, ReplayEngine,  # noqa: F401
                      per_shard_hot_rows, rank_candidates, replay_trace)

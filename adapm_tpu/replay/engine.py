@@ -109,6 +109,10 @@ def _build_opts(trace: WorkloadTrace, overrides: Optional[Dict]):
     # scoring reads the registry; capture never recurses into replay
     opts.metrics = True
     opts.trace_workload = None
+    # decision capture (ISSUE 17) stays with the system that recorded
+    # the workload: a replay re-decides under the candidate policy, and
+    # its decisions are scored via the registry, not re-captured
+    opts.trace_decisions = None
     # output/periodic hygiene: a replay run must not write the
     # captured run's stats/traces/checkpoint chains or re-arm its
     # timers — those belong to the system that recorded them
@@ -145,6 +149,11 @@ def _build_opts(trace: WorkloadTrace, overrides: Optional[Dict]):
     if opts.trace_workload:
         raise ValueError("replay must not capture itself; do not "
                          "override trace_workload")
+    if opts.trace_decisions:
+        raise ValueError("replay must not capture itself; do not "
+                         "override trace_decisions (export the "
+                         "labeled dataset from the CAPTURED run's "
+                         ".dtrace via replay/dataset.py)")
     opts.validate_serve()
     return opts, num_shards
 

@@ -492,6 +492,15 @@ class LookupBatcher:
                 if verdicts and all(v is False for v in verdicts):
                     fused = False
                     costs.c_overrides.inc()
+                dc = srv.decisions
+                if dc is not None and verdicts:
+                    # ISSUE 17: the measured-cost dispatch verdict for
+                    # this bag batch (outcome immediate — the table is
+                    # already measured)
+                    dc.record_costs(
+                        fused, len(verdicts), len(union),
+                        sum(1 for v in verdicts if v is False),
+                        sum(1 for v in verdicts if v is None))
             if fused:
                 dev, t_enqueued = self._lookup_bags_fused(groups)
                 pooled = {k: np.asarray(v)[:groups[k]["nbags"]]

@@ -226,10 +226,13 @@ def ensure_hot_rows(server, store, shards: np.ndarray, slots: np.ndarray,
         # residents (equal scores never churn)
         is_pin = res.pinned_mask(s, cold, min_clock)
         pc, uc = cold[is_pin], cold[~is_pin]
+        n_pinned, n_unpinned = len(pc), len(uc)
+        n_victims = n_beat = 0
         if len(pc):
             short = len(pc) - res.alloc.num_free(s)
             if short > 0:
                 victims = _pick_victims(store, s, short, min_clock, sl)
+                n_victims += len(victims)
                 if len(victims):
                     _count_demotions(server,
                                      demote_rows(store, s, victims))
@@ -239,12 +242,14 @@ def ensure_hot_rows(server, store, shards: np.ndarray, slots: np.ndarray,
             if over > 0:
                 uc = uc[np.argsort(-res.score[s, uc], kind="stable")]
                 victims = _pick_victims(store, s, over, min_clock, sl)
+                n_victims += len(victims)
                 if len(victims):
                     victims = victims[np.argsort(
                         res.score[s, victims], kind="stable")]
                     k = min(len(victims), len(uc))
                     beat = res.score[s, victims[:k]] < \
                         res.score[s, uc[:k]]
+                    n_beat = int(beat.sum())
                     if beat.any():
                         _count_demotions(
                             server,
@@ -252,6 +257,15 @@ def ensure_hot_rows(server, store, shards: np.ndarray, slots: np.ndarray,
                 uc = uc[: res.alloc.num_free(s)]
             if len(uc):
                 n += promote_rows(store, s, uc)
+        dc = server.decisions
+        if dc is not None and (n_pinned or n_unpinned):
+            # ISSUE 17: this shard's promotion batch with the
+            # anti-thrash verdict (pin split, victims scanned, victims
+            # strictly beaten); the promoted rows open an outcome
+            # window probing re-touch-while-hot
+            dc.record_tier(store, s, np.concatenate((pc, uc)),
+                           n_pinned, n_unpinned, n_victims, n_beat,
+                           min_clock)
     return n
 
 
@@ -411,6 +425,12 @@ class PromotionEngine:
                 if n:
                     moved += n
                     mgr.c_demotions.inc(n)
+                    dc = srv.decisions
+                    if dc is not None:
+                        # ISSUE 17: headroom-reclaim demotion (outcome
+                        # immediate — its cost surfaces as later
+                        # promotions' regret, not its own)
+                        dc.record_tier_demote(s, n, free, target)
         # 3. score decay
         self._passes += 1
         if self._passes % self._DECAY_EVERY == 0:

@@ -1009,12 +1009,17 @@ def bench_replay(E=8_000, vlen=16, steps=120, skew=8.0):
     the artifact carries the captured-trace shape, per-candidate
     hot-hit/serve scores, the ranked comparison, and the determinism
     digest (same seed + knobs => bit-identical reads, re-verified
-    here with a second run of the winner)."""
+    here with a second run of the winner). The capture run also
+    records the decision plane (ISSUE 17, --sys.trace.decisions) and
+    the artifact embeds the labeled-dataset summary — decisions per
+    plane, attribution closure, regret counts — from the same
+    workload."""
     import tempfile
 
     import adapm_tpu
     from adapm_tpu.config import SystemOptions
-    from adapm_tpu.replay import (ReplayEngine, load_wtrace,
+    from adapm_tpu.replay import (ReplayEngine, export_dataset,
+                                  load_dtrace, load_wtrace,
                                   per_shard_hot_rows, rank_candidates)
     from adapm_tpu.serve import ServePlane
 
@@ -1023,11 +1028,19 @@ def bench_replay(E=8_000, vlen=16, steps=120, skew=8.0):
     # phase (success or failure)
     with tempfile.TemporaryDirectory(prefix="adapm_replay_") as tmp:
         path = os.path.join(tmp, "bench.wtrace")
+        dpath = os.path.join(tmp, "bench.dtrace")
         _progress(f"replay phase: capturing workload ({E} keys x "
                   f"{vlen}, {steps} steps)")
+        # tier on for the CAPTURE run so the decision plane has real
+        # promote/demote choices to record (replay re-decides
+        # management from the op stream, and every candidate overrides
+        # the tier knobs — the sweep is unaffected)
         opts = SystemOptions(sync_max_per_sec=0, prefetch=False,
+                             tier=True,
+                             tier_hot_rows=per_shard_hot_rows(E, 0.5),
                              trace_workload=path,
-                             trace_workload_keys=512)
+                             trace_workload_keys=512,
+                             trace_decisions=dpath)
         srv = adapm_tpu.setup(E, vlen, opts=opts, num_workers=1)
         w = srv.make_worker(0)
         rng = np.random.default_rng(0)
@@ -1051,6 +1064,10 @@ def bench_replay(E=8_000, vlen=16, steps=120, skew=8.0):
         plane.close()
         srv.shutdown()
         tr = load_wtrace(path)
+        # join the decision trace against the op stream while both
+        # files still exist (the labeled-dataset summary the policy
+        # lab consumes; docs/OBSERVABILITY.md "Explain a decision")
+        ds = export_dataset(load_dtrace(dpath), tr)
     # per_shard_hot_rows: --sys.tier.hot_rows is PER SHARD, so these
     # whole-table fractions divide by the device count (the helper is
     # shared with scripts/trace_replay_check.py)
@@ -1085,6 +1102,11 @@ def bench_replay(E=8_000, vlen=16, steps=120, skew=8.0):
     return {"capture_s": round(t_capture, 3),
             "trace_events": len(tr.events),
             "trace_kinds": tr.kinds(),
+            "decisions": {"planes": ds["planes"],
+                          "rows": ds["n_rows"],
+                          "unresolved": ds["n_unresolved"],
+                          "regretted": ds["n_regretted"],
+                          "columns": len(ds["columns"])},
             "replay_deterministic": bool(deterministic),
             "winner": win,
             "ranking": art["ranking"],

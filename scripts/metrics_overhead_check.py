@@ -117,6 +117,23 @@ def main() -> int:
         f"{wtrace_names}"
     print("[overhead-check] workload capture default-off: no recorder, "
           "zero wtrace.* names; capture hooks are zero-cost skips")
+    # ISSUE 17: decision telemetry is compiled in but DEFAULT OFF — no
+    # DecisionRecorder, zero decision.* registry names, and every
+    # decision site (relocate-vs-replicate classify, landed moves, tier
+    # promote/demote, dirty-sync ship/hold, SLO moves, prefetch
+    # stage/skip, cost overrides) pays one `is None` check. The
+    # unchanged median-ratio guard below times the pull/push hot path
+    # with those branches present.
+    assert srv.decisions is None, \
+        "decision telemetry must be DEFAULT OFF (--sys.trace.decisions " \
+        "unset)"
+    decision_names = [n for n in names if n.startswith("decision.")]
+    assert not decision_names, \
+        f"default-off decision telemetry registered metrics: " \
+        f"{decision_names}"
+    print("[overhead-check] decision telemetry default-off: no "
+          "recorder, zero decision.* names; decision sites are "
+          "zero-cost skips")
     saved = (w._h_pull, w._h_push, w._h_set, srv.sync._h_round)
     probe(w, batches, vals, 30)  # warm the jit caches
     # per-pair (off, on) timings back to back; the guard is the MEDIAN

@@ -82,5 +82,11 @@ python scripts/fault_drill_check.py
 # (median pairwise, < 0.9 on accelerators; near-parity bar on CPU
 # hosts where the wire-byte saving is a memcpy — ADAPM_BAG_RATIO_MAX)
 python scripts/portdiff_check.py
+# decision-telemetry guard (ISSUE 17): a captured zipf storm's decision
+# trace must carry a complete feature vector on every event, close
+# >= 90% of outcome-attribution windows, export a byte-deterministic
+# labeled dataset, and fold a strictly higher tier regret rate under a
+# thrashing (tiny) hot pool than under an ample one
+python scripts/decision_quality_check.py
 python -m pytest tests/ -q "$@"
 echo "ALL TESTS PASSED"

@@ -460,3 +460,53 @@ def test_bag_and_costs_knobs_round_trip_and_rejection():
     # non-integer bag flag rejected by argparse itself
     with pytest.raises(SystemExit):
         p.parse_args(["--sys.serve.bags", "maybe"])
+
+
+def test_decision_trace_knobs_round_trip_and_rejection():
+    """--sys.trace.decisions / --sys.trace.decisions_window /
+    --sys.trace.spans.max_events (ISSUE 17): parse into the options
+    the DecisionRecorder and SpanTracer consume, decisions default OFF
+    (no recorder, zero decision.* names — pinned by
+    tests/test_decisions.py and scripts/metrics_overhead_check.py);
+    an empty .dtrace path, a zero follow window, and a sub-1000 span
+    bound are each rejected at parse time AND on hand-built options."""
+    import argparse
+
+    import pytest
+
+    from adapm_tpu.config import SystemOptions
+    p = argparse.ArgumentParser()
+    SystemOptions.add_arguments(p)
+    dflt = SystemOptions.from_args(p.parse_args([]))
+    assert dflt.trace_decisions is None
+    assert dflt.trace_decisions_window == 8
+    assert dflt.trace_spans_max_events == 1_000_000
+    on = SystemOptions.from_args(p.parse_args(
+        ["--sys.trace.decisions", "/tmp/run.dtrace",
+         "--sys.trace.decisions_window", "16",
+         "--sys.trace.spans.max_events", "5000"]))
+    assert on.trace_decisions == "/tmp/run.dtrace"
+    assert on.trace_decisions_window == 16
+    assert on.trace_spans_max_events == 5000
+    # an empty path can flush nothing — rejected loudly
+    with pytest.raises(ValueError, match="trace.decisions"):
+        SystemOptions.from_args(p.parse_args(
+            ["--sys.trace.decisions", ""]))
+    with pytest.raises(ValueError, match="trace.decisions"):
+        SystemOptions(trace_decisions="").validate_serve()
+    # a zero-event follow window can never resolve an outcome
+    with pytest.raises(ValueError, match="decisions_window"):
+        SystemOptions.from_args(p.parse_args(
+            ["--sys.trace.decisions", "/tmp/run.dtrace",
+             "--sys.trace.decisions_window", "0"]))
+    with pytest.raises(ValueError, match="decisions_window"):
+        SystemOptions(trace_decisions_window=0).validate_serve()
+    # a tiny span buffer silently truncates every trace — floor 1000
+    with pytest.raises(ValueError, match="spans.max_events"):
+        SystemOptions.from_args(p.parse_args(
+            ["--sys.trace.spans.max_events", "100"]))
+    with pytest.raises(ValueError, match="spans.max_events"):
+        SystemOptions(trace_spans_max_events=999).validate_serve()
+    # non-integer values rejected by argparse itself
+    with pytest.raises(SystemExit):
+        p.parse_args(["--sys.trace.decisions_window", "soon"])

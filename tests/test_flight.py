@@ -446,6 +446,7 @@ class _FakeServer:
     def __init__(self):
         from adapm_tpu.obs.metrics import MetricsRegistry
         self.obs = MetricsRegistry()
+        self.decisions = None  # decision telemetry off (ISSUE 17)
 
 
 def _mk_controller(target_ms=10.0, wait_us=20_000):
@@ -673,14 +674,22 @@ def test_reporter_line_format():
                                 "buckets": [4, 0]}},
         "exec": {"programs_total": 3, "overlap_fraction": 0.25},
         "tier": {"hot_hits": 9, "cold_hits": 1, "hot_hit_rate": 0.9},
+        "flight": {"freshness_s": {"count": 2, "bounds": [0.002],
+                                   "buckets": [2, 0]}},
+        "decision": {"events_total": 10, "regret_rate.tier": 0.25,
+                     "regret_rate.sync": 0.10},
     }
     assert _fmt(snap) == ("pull=2 avg=1.05ms "
                           "serve=4 p50=0.50ms p99=0.99ms "
-                          "overlap=0.25 hot_hit=0.90")
+                          "overlap=0.25 hot_hit=0.90 "
+                          "fresh=1.98ms regret=0.25")
     # a subsystem with no activity contributes nothing (no empty fields)
     assert _fmt({"serve": {"latency_s": {"count": 0}},
                  "exec": {"programs_total": 0},
-                 "tier": {"hot_hits": 0, "cold_hits": 0}}) \
+                 "tier": {"hot_hits": 0, "cold_hits": 0},
+                 "flight": {"freshness_s": {"count": 0}},
+                 "decision": {"events_total": 0,
+                              "regret_rate.tier": 0.0}}) \
         == "no activity yet"
 
 

@@ -336,6 +336,26 @@ class SystemOptions:
     ckpt_every_s: float = 0.0
     ckpt_path: Optional[str] = None
 
+    # -- learned adaptive-policy plane (sys.policy.*; adapm_tpu/
+    #    policy, docs/POLICY.md). policy_file names a trained artifact
+    #    (`python -m adapm_tpu.policy.train`); each per-plane mode
+    #    knob picks `heuristic` (default — the hand-tuned law, exactly
+    #    as before) or `learned` (the trained regret scorer may VETO
+    #    the heuristic's action through a value-preservation guard —
+    #    a policy changes what/when, never values). policy_shadow
+    #    scores the learned policy live WITHOUT applying it
+    #    (policy.shadow_agree/disagree — the promotion runbook's A/B).
+    #    No file (the default) means NO PolicyPlane exists: every hook
+    #    site pays one `is None` check and the registry holds zero
+    #    policy.* names (the r7 skip-wrapper discipline;
+    #    scripts/metrics_overhead_check.py).
+    policy_reloc: str = "heuristic"
+    policy_tier: str = "heuristic"
+    policy_sync: str = "heuristic"
+    policy_serve: str = "heuristic"
+    policy_file: Optional[str] = None
+    policy_shadow: bool = False
+
     # -- runtime lock-order sentinel (sys.lint.*; adapm_tpu/lint/
     #    lockorder.py, docs/INVARIANTS.md): wrap the server lock, the
     #    dispatch gate, and the admission/registry locks in a recorder
@@ -483,6 +503,33 @@ class SystemOptions:
                 f"(got {self.trace_spans_max_events}): a smaller bound "
                 f"would drop nearly every span — an unreadable trace "
                 f"masquerading as a cheap one")
+        _policy_planes = (("reloc", self.policy_reloc),
+                          ("tier", self.policy_tier),
+                          ("sync", self.policy_sync),
+                          ("serve", self.policy_serve))
+        for _plane, _mode in _policy_planes:
+            if _mode not in ("heuristic", "learned"):
+                raise ValueError(
+                    f"--sys.policy.{_plane} must be heuristic or "
+                    f"learned (got {_mode!r})")
+        if self.policy_file is not None and not self.policy_file:
+            raise ValueError(
+                "--sys.policy.file needs a non-empty path for the "
+                "policy artifact (omit the flag to run pure "
+                "heuristics)")
+        if not self.policy_file:
+            _learned = [p for p, m in _policy_planes if m == "learned"]
+            if _learned:
+                raise ValueError(
+                    f"--sys.policy.{_learned[0]} learned requires "
+                    f"--sys.policy.file: a learned mode without a "
+                    f"trained artifact has nothing to consult")
+            if self.policy_shadow:
+                raise ValueError(
+                    "--sys.policy.shadow requires --sys.policy.file: "
+                    "shadow mode scores the TRAINED policy against "
+                    "the live heuristic and is meaningless without "
+                    "an artifact")
         if self.fault_spec:
             from .fault.inject import parse_fault_spec
             parse_fault_spec(self.fault_spec)  # raises ValueError on a
@@ -665,6 +712,22 @@ class SystemOptions:
                        dest="sys_ckpt_every", type=float, default=0.0)
         g.add_argument("--sys.checkpoint.path",
                        dest="sys_ckpt_path", default=None)
+        g.add_argument("--sys.policy.reloc", dest="sys_policy_reloc",
+                       default="heuristic",
+                       choices=["heuristic", "learned"])
+        g.add_argument("--sys.policy.tier", dest="sys_policy_tier",
+                       default="heuristic",
+                       choices=["heuristic", "learned"])
+        g.add_argument("--sys.policy.sync", dest="sys_policy_sync",
+                       default="heuristic",
+                       choices=["heuristic", "learned"])
+        g.add_argument("--sys.policy.serve", dest="sys_policy_serve",
+                       default="heuristic",
+                       choices=["heuristic", "learned"])
+        g.add_argument("--sys.policy.file", dest="sys_policy_file",
+                       default=None)
+        g.add_argument("--sys.policy.shadow",
+                       dest="sys_policy_shadow", type=int, default=0)
         g.add_argument("--sys.lint.lockorder",
                        dest="sys_lint_lockorder", type=int, default=0)
         s = parser.add_argument_group("sampling")
@@ -749,6 +812,12 @@ class SystemOptions:
             fault_watchdog_s=args.sys_fault_watchdog_s,
             ckpt_every_s=args.sys_ckpt_every,
             ckpt_path=args.sys_ckpt_path,
+            policy_reloc=args.sys_policy_reloc,
+            policy_tier=args.sys_policy_tier,
+            policy_sync=args.sys_policy_sync,
+            policy_serve=args.sys_policy_serve,
+            policy_file=args.sys_policy_file,
+            policy_shadow=bool(args.sys_policy_shadow),
             lint_lockorder=bool(args.sys_lint_lockorder),
             sampling_scheme=args.sampling_scheme,
             sampling_reuse_factor=args.sampling_reuse,

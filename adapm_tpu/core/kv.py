@@ -169,6 +169,19 @@ class Server:
             self.decisions = DecisionRecorder(
                 self, self.opts.trace_decisions,
                 follow_events=self.opts.trace_decisions_window)
+        # learned adaptive-policy plane (ISSUE 18 tentpole;
+        # adapm_tpu/policy): per-plane trained regret scorers that may
+        # VETO a heuristic decision (--sys.policy.<plane> learned) or
+        # shadow-score it without applying (--sys.policy.shadow).
+        # Default off — when None every hook site pays one `is None`
+        # check (the r7 skip-wrapper discipline) and the registry
+        # holds zero policy.* names. A corrupt/incompatible artifact
+        # raises the named PolicyError HERE, before any plane consults
+        # it.
+        self.policy = None
+        if self.opts.policy_file:
+            from ..policy.runtime import PolicyPlane
+            self.policy = PolicyPlane(self)
         # populated by a ReplayEngine that drove this server (the
         # snapshot's always-present `replay` section; schema v11)
         self.replay_stats: Optional[Dict] = None
@@ -1144,6 +1157,28 @@ class Server:
         pool is full is demoted to a replication attempt (the planner's
         graceful-degradation policy, sync.py _register) rather than
         silently dropped."""
+        pol = self.policy
+        if pol is not None and len(keys) and pol.active("reloc"):
+            # ISSUE 18 learned reloc law: predicted move-thrash regret
+            # (the plane's `move` outcome — locality 0 at window
+            # close) may HOLD the whole batch in place; the keys stay
+            # owned where they are and every pull/push reaches the
+            # same main row immediately — slower, never wrong.
+            # Value-preservation guard: a dest replica's pending delta
+            # merges in-kernel AT relocate time, so holding the move
+            # is only a bitwise no-op when every dest replica in the
+            # batch is verifiably clean (the exact store-epoch mask,
+            # never a heuristic); otherwise the heuristic's move
+            # proceeds unvetoed.
+            if pol.consult("reloc",
+                           {"n_moved": len(keys), "n_demoted": 0},
+                           len(keys)):
+                rk = keys[self.ab.cache_slot[dest, keys] >= 0]
+                if len(rk) == 0 or not self._dirty_replica_mask(
+                        rk, np.full(len(rk), dest, np.int32)).any():
+                    pol.applied("reloc")
+                    return 0
+                pol.guard_blocked("reloc")
         demoted = np.empty(0, dtype=np.int64)
         n_moved = 0
         with self._lock:
@@ -1599,7 +1634,7 @@ class Server:
                           "sync", "pm", "collective", "fused", "spans",
                           "serve", "tier", "exec", "flight", "slo",
                           "fault", "ckpt", "device", "episode",
-                          "wtrace", "replay", "decision")
+                          "wtrace", "replay", "decision", "policy")
 
     def metrics_snapshot(self, drain_device: bool = True) -> Dict:
         """One structured, JSON-serializable telemetry dict for this
@@ -1735,8 +1770,20 @@ class Server:
         gains `spans.dropped` (registered while a SpanTracer exists):
         span-buffer overflow drops, counted loudly instead of silently
         capping at the old hardcoded 1M bound (now
-        `--sys.trace.spans.max_events`)."""
-        out: Dict = {"schema_version": 13,
+        `--sys.trace.spans.max_events`).
+
+        schema_version 14 (PR 18): always-present `policy` section
+        (ISSUE 18; adapm_tpu/policy, `--sys.policy.*`) — the learned
+        adaptive-policy plane's consult/veto counters
+        (`policy.consults_total`, `policy.applied_total`,
+        `policy.guard_vetoes_total`), the shadow A/B tallies
+        (`policy.shadow_agree` / `policy.shadow_disagree`), and the
+        plane's stats dict (per-plane mode/consults/vetoes/applied/
+        guard-blocked/agree/disagree, the loaded artifact path, and
+        the serve batch-window close-reason tallies); `{}` when no
+        `--sys.policy.file` is set (no PolicyPlane object, zero
+        policy.* names)."""
+        out: Dict = {"schema_version": 14,
                      "metrics_enabled": bool(self.obs.enabled)}
         for s in self._SNAPSHOT_SECTIONS:
             out[s] = {}
@@ -1796,6 +1843,8 @@ class Server:
             out["wtrace"].update(self.wtrace.stats())
         if self.decisions is not None:
             out["decision"].update(self.decisions.stats())
+        if self.policy is not None:
+            out["policy"].update(self.policy.stats())
         if self.replay_stats is not None:
             out["replay"].update(self.replay_stats)
         if self._serve_plane is not None and \

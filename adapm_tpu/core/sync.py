@@ -558,6 +558,28 @@ class SyncManager:
                     # channel, so the batch is self-contained.
                     dirty |= np.isin(kk, kk[dirty])
                 kk, ks = kk[dirty], ks[dirty]
+            else:
+                pol = srv.policy
+                if pol is not None and pol.active("sync"):
+                    # ISSUE 18 learned sync law: with the static dirty
+                    # filter OFF the heuristic ships every kept
+                    # replica; a predicted wasted-wire verdict applies
+                    # the EXACT per-batch dirty mask instead — the
+                    # same value-preservation guard the filter-on
+                    # branch above is built on (a clean replica's sync
+                    # program is a bit-for-bit no-op, so holding it
+                    # cannot change any read; sibling ride-alongs keep
+                    # the post-merge refresh rule). A wrong prediction
+                    # costs one mask pass — it never ships less than
+                    # the dirty set.
+                    if pol.consult("sync", {"n_dirty": -1},
+                                   n_considered):
+                        pol.applied("sync")
+                        dirty = srv._dirty_replica_mask(kk, ks)
+                        n_dirty = int(dirty.sum())
+                        if dirty.any() and not dirty.all():
+                            dirty |= np.isin(kk, kk[dirty])
+                        kk, ks = kk[dirty], ks[dirty]
             dc = srv.decisions
             if dc is not None:
                 # ISSUE 17: the ship/hold verdict for this channel's

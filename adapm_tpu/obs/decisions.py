@@ -79,6 +79,13 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+# the shared feature-extraction contract (ISSUE 18 tentpole a): the
+# SAME module the runtime PolicyPlane vectorizes through, so a feature
+# the capture records is by construction a feature inference computes
+# identically. CORE_FEATURES is re-exported here for existing
+# consumers (scripts/decision_quality_check.py).
+from ..policy.features import CORE_FEATURES, core_features  # noqa: F401
+
 DTRACE_FORMAT = "adapm-dtrace"
 DTRACE_VERSION = 1
 
@@ -87,12 +94,6 @@ DTRACE_VERSION = 1
 # than the op stream, so the defaults are generous
 DEFAULT_MAX_EVENTS = 1_000_000
 DEFAULT_MAX_BYTES = 256 * 1024 * 1024
-
-# the feature keys EVERY decision event carries (the "complete feature
-# vector" contract scripts/decision_quality_check.py pins); planes add
-# their own fields on top
-CORE_FEATURES = ("clock", "replicas_live", "dirty_fraction",
-                 "hot_free_rows", "hot_total_rows", "batch_n")
 
 # planes that open follow-up windows and fold a regret rate
 _REGRET_PLANES = ("reloc", "tier", "sync", "serve", "prefetch")
@@ -148,14 +149,22 @@ class DecisionRecorder:
     bumps only — never a device wait, never the server lock); window
     resolution runs outside it on pure host reads."""
 
-    def __init__(self, server, path: str, follow_events: int = 8,
-                 follow_s: float = 2.0,
+    def __init__(self, server, path: Optional[str],
+                 follow_events: int = 8, follow_s: float = 2.0,
                  max_events: int = DEFAULT_MAX_EVENTS,
                  max_bytes: int = DEFAULT_MAX_BYTES):
         from .metrics import Counter, Gauge
-        if not path:
+        if path is not None and not path:
             raise ValueError("decision trace capture needs a path "
                              "(--sys.trace.decisions)")
+        # path=None is the METRICS-ONLY mode (internal; the CLI knob
+        # always names a file): windows open, outcomes resolve, and
+        # the regret gauges fold exactly as in capture mode, but
+        # flush() writes nothing. The replay engine uses this to score
+        # a candidate's decision quality (`score_decisions=True`)
+        # while still PINNING `trace_decisions` off — the simulator
+        # scores itself through the registry, it never emits a trace
+        # of itself (replay/engine.py).
         self._server = server
         self.path = path
         self.follow_events = max(1, int(follow_events))
@@ -249,30 +258,11 @@ class DecisionRecorder:
         return seq
 
     def _features(self, batch_n: int) -> Dict:
-        """The CORE_FEATURES context visible at decision time — all
-        lock-free host reads (dirty fraction is the sync plane's
-        memoized gauge read; hot-pool occupancy is the allocator's
-        free-count)."""
-        srv = self._server
-        sync = srv.sync
-        out = {"clock": self._server_clock(),
-               "replicas_live": int(sum(len(t) for t in sync.replicas)),
-               "dirty_fraction": round(float(sync._dirty_fraction(None)),
-                                       6),
-               "hot_free_rows": 0, "hot_total_rows": 0,
-               "batch_n": int(batch_n)}
-        if srv.tier is not None:
-            free = total = 0
-            for st in srv.stores:
-                res = getattr(st, "res", None)
-                if res is None:
-                    continue
-                total += int(res.hot_rows) * int(res.num_shards)
-                free += int(sum(res.alloc.num_free(s)
-                                for s in range(res.num_shards)))
-            out["hot_free_rows"] = free
-            out["hot_total_rows"] = total
-        return out
+        """The CORE_FEATURES context visible at decision time, through
+        the SHARED extractor (policy/features.py) — the same code path
+        runtime inference reads, so a trained model's inputs mean
+        exactly what the captured rows meant."""
+        return core_features(self._server, batch_n)
 
     def _record(self, plane: str, action: str, features: Dict,
                 **fields) -> Optional[int]:
@@ -611,8 +601,11 @@ class DecisionRecorder:
 
     def flush(self) -> str:
         """Write the full trace atomically (wtrace header discipline);
-        returns the path. Safe to call mid-run for a point-in-time
+        returns the path (empty string in metrics-only mode — there is
+        no file to write). Safe to call mid-run for a point-in-time
         trace; close() performs the final flush."""
+        if self.path is None:
+            return ""
         from .wtrace import write_trace_file
         with self._flush_lock:
             with self._lock:

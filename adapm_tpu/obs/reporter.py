@@ -26,6 +26,11 @@ subsystem has activity, always in this order:
                                                (flight.freshness_s)
     regret=<ratio>                             worst per-plane decision
                                                regret rate (ISSUE 17)
+    policy=<applied>/<consults>                learned-policy verdicts
+                                               applied vs consults;
+                                               `shadow_dis=<n>` rides
+                                               along when shadow mode
+                                               disagreed (ISSUE 18)
 
 Ratios are 2-decimal, latencies 2-decimal milliseconds."""
 from __future__ import annotations
@@ -87,6 +92,15 @@ def _fmt(snap: dict) -> str:
              and isinstance(v, (int, float))]
     if dc.get("events_total") and rates:
         parts.append(f"regret={max(rates):.2f}")
+    # learned-policy plane: verdicts applied vs consults once any
+    # decision site consulted a model (ISSUE 18); absent by default —
+    # the policy counters only register when a policy file is loaded
+    po = snap.get("policy", {})
+    if po.get("consults_total"):
+        parts.append(f"policy={po.get('applied_total', 0)}"
+                     f"/{po['consults_total']}")
+        if po.get("shadow_disagree"):
+            parts.append(f"shadow_dis={po['shadow_disagree']}")
     return " ".join(parts) or "no activity yet"
 
 

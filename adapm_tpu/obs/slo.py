@@ -168,6 +168,24 @@ class SLOController:
             return  # deadband: hysteresis against knob chatter
         if new == cur:
             return
+        pol = getattr(self.server, "policy", None)
+        if pol is not None and pol.active("serve"):
+            # ISSUE 18 learned serve law: the heuristic still PROPOSES
+            # every move (bounded by [lo_us, hi_us] above); a
+            # predicted made-the-tail-worse verdict holds the window
+            # at its current value instead of applying the move. The
+            # batch window only changes WHEN requests dispatch, never
+            # the rows a lookup returns, so no further
+            # value-preservation guard is needed. Features are
+            # rounded exactly as record_serve captures them — the
+            # train/serve contract (policy/features.py).
+            if pol.consult("serve",
+                           {"old_us": cur, "new_us": new,
+                            "p99_ms": round(p99 * 1e3, 3),
+                            "target_ms": round(self.target_s * 1e3,
+                                               3)}, 1):
+                pol.applied("serve")
+                return
         self.batcher.max_wait_us = new
         self.c_adjust.inc()
         self.g_wait.set(float(new))

@@ -1,0 +1,29 @@
+"""Learned adaptive-policy plane (ISSUE 18): offline-trained
+relocate/tier/sync/batch-window policies, replay-gated promotion, and
+live shadow A/B. See docs/POLICY.md.
+
+Layout:
+
+  features.py  the ONE shared feature extractor + per-plane ordered
+               input specs (capture and inference both import it —
+               train/serve skew is impossible by construction)
+  model.py     deterministic pure-NumPy per-plane regret scorers,
+               serialized as a versioned, checksummed JSON artifact
+  train.py     `python -m adapm_tpu.policy.train` — fit from the
+               replay/dataset.py labeled table
+  runtime.py   `PolicyPlane` — the live veto/shadow hook surface
+               behind `--sys.policy.*` (built by core/kv.py)
+"""
+from .features import CORE_FEATURES, PLANE_FEATURES, core_features, \
+    vectorize
+from .model import POLICY_FORMAT, POLICY_VERSION, PlaneModel, \
+    PolicyBundle, PolicyError, load_policy
+from .runtime import PLANE_KNOBS, POLICY_MODES, PolicyPlane
+from .train import train_policy
+
+__all__ = [
+    "CORE_FEATURES", "PLANE_FEATURES", "core_features", "vectorize",
+    "POLICY_FORMAT", "POLICY_VERSION", "PlaneModel", "PolicyBundle",
+    "PolicyError", "load_policy", "PLANE_KNOBS", "POLICY_MODES",
+    "PolicyPlane", "train_policy",
+]

@@ -1,0 +1,93 @@
+"""ONE shared feature-extraction module for the decision planes
+(ISSUE 18 tentpole a).
+
+Both halves of the policy loop import THIS module:
+
+  - capture (`obs/decisions.py DecisionRecorder`) stamps every decision
+    event's `features` dict through `core_features()` plus the plane's
+    extra fields, and
+  - runtime inference (`policy/runtime.py PolicyPlane`) builds the
+    model input through the SAME `core_features()` + `vectorize()`,
+
+so train/serve skew is impossible by construction: a feature the model
+was fit on is, by definition, a feature the live site computes the
+same way. `PLANE_FEATURES` is the other half of that contract — the
+ORDERED per-plane input spec. Training (`policy/train.py`) selects
+exactly these columns from the dataset's `f.*` fields and `vectorize`
+lays the live dict out in the same order; columns the capture records
+but the spec omits (post-decision counts like `n_shipped`, verdict
+tallies like `n_beat`) are visible in the dataset for analysis but can
+never leak into a model input, because they are not known at the
+moment the live site must decide.
+
+Dependency-light on purpose (numpy only): `obs/decisions.py` imports
+this module at the top level, so it must not pull in the obs/metrics
+stack.
+"""
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import numpy as np
+
+# the feature keys EVERY decision event carries (the "complete feature
+# vector" contract scripts/decision_quality_check.py pins); planes add
+# their own fields on top
+CORE_FEATURES = ("clock", "replicas_live", "dirty_fraction",
+                 "hot_free_rows", "hot_total_rows", "batch_n")
+
+# ordered model-input spec per plane: CORE_FEATURES plus the
+# plane-specific fields that are known BEFORE the action is taken at
+# the live hook site (see module docstring — post-decision fields are
+# deliberately excluded)
+PLANE_FEATURES: Dict[str, Tuple[str, ...]] = {
+    # kv._relocate_to: the landed-move veto sees the batch about to
+    # move (nothing demoted yet)
+    "reloc": CORE_FEATURES + ("n_moved", "n_demoted"),
+    # tier ensure_hot_rows background path: the pin split is computed
+    # before any promotion; victims/beaten are only known after
+    "tier": CORE_FEATURES + ("n_pinned", "n_unpinned"),
+    # sync_channel ship/hold: dirty count as the heuristic saw it
+    # (-1 = dirty filter off, dirtiness unknown at decision time)
+    "sync": CORE_FEATURES + ("n_dirty",),
+    # obs/slo.py _control: the proposed window move and the tail it
+    # reacts to
+    "serve": CORE_FEATURES + ("old_us", "new_us", "p99_ms",
+                              "target_ms"),
+}
+
+
+def core_features(server, batch_n: int) -> Dict:
+    """The CORE_FEATURES context visible at decision time — all
+    lock-free host reads (dirty fraction is the sync plane's memoized
+    gauge read; hot-pool occupancy is the allocator's free-count).
+    Never takes the server lock, never waits on the device."""
+    sync = server.sync
+    c = server._clocks
+    out = {"clock": int(c.max()) if len(c) else 0,
+           "replicas_live": int(sum(len(t) for t in sync.replicas)),
+           "dirty_fraction": round(float(sync._dirty_fraction(None)), 6),
+           "hot_free_rows": 0, "hot_total_rows": 0,
+           "batch_n": int(batch_n)}
+    if server.tier is not None:
+        free = total = 0
+        for st in server.stores:
+            res = getattr(st, "res", None)
+            if res is None:
+                continue
+            total += int(res.hot_rows) * int(res.num_shards)
+            free += int(sum(res.alloc.num_free(s)
+                            for s in range(res.num_shards)))
+        out["hot_free_rows"] = free
+        out["hot_total_rows"] = total
+    return out
+
+
+def vectorize(plane: str, features: Dict) -> np.ndarray:
+    """Lay a feature dict out as the plane's ordered model-input
+    vector (float64; missing fields are 0.0 — e.g. `hot_free_rows`
+    on an untiered server). Raises KeyError for an unknown plane: a
+    model for a plane this spec does not define cannot exist."""
+    spec = PLANE_FEATURES[plane]
+    return np.array([float(features.get(k, 0.0)) for k in spec],
+                    dtype=np.float64)

@@ -1,0 +1,172 @@
+"""Offline policy training: fit the per-plane regret scorers from a
+capture run's traces (ISSUE 18 tentpole c).
+
+    python -m adapm_tpu.policy.train run.dtrace run.wtrace -o policy.json
+
+Pipeline: `replay/dataset.py export_dataset` joins the `.dtrace` and
+(optionally) `.wtrace` into the labeled (features, decision, outcome)
+table; per plane, the rows whose action matches the plane's live hook
+site (reloc `move`, tier `promote`, sync `ship`/`hold`, serve
+`shrink`/`grow`) become a training set with the plane's OWN regret
+verdict as the label, and `model.fit_logistic` fits the scorer over
+exactly the `PLANE_FEATURES` columns (policy/features.py — the same
+module the live sites vectorize through, so train/serve skew is
+impossible by construction).
+
+Label hygiene (ISSUE 18 satellite):
+
+  - **Unresolved rows are not labels.** A decision whose outcome
+    window never resolved (dropped under the event budget, run died)
+    has `regret: null` and is skipped.
+  - **Forced-close rows are not labels.** A window resolved by
+    `close()` at shutdown (`truncated: true`) observed an arbitrary
+    prefix of its follow-up horizon — its verdict reflects when the
+    run ended, not what the decision bought. These rows are
+    down-weighted by `--truncated-weight` (default 0.0 = excluded)
+    and counted LOUDLY: the CLI prints
+    `policy.train.truncated_rows=N` and the artifact's per-plane
+    `train` meta carries the count.
+  - A plane with too few usable rows, or only one label class, gets
+    the deterministic base-rate constant model (model.py
+    `PlaneModel.constant`) — with the default 0.5 threshold it never
+    vetoes unless the base regret rate itself crosses it.
+
+Byte determinism: no RNG is consumed and no timestamp is minted — the
+same dataset + seed re-trains to a byte-identical artifact
+(`scripts/policy_gate_check.py` pins this).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .features import PLANE_FEATURES
+from .model import PlaneModel, PolicyBundle, fit_logistic
+
+# dataset actions whose pre-decision features match each plane's live
+# hook site (policy/features.py PLANE_FEATURES); other actions of the
+# same plane (reloc `classify`, tier `demote`) are analysis-only
+PLANE_ACTIONS: Dict[str, tuple] = {
+    "reloc": ("move",),
+    "tier": ("promote",),
+    "sync": ("ship", "hold"),
+    "serve": ("shrink", "grow"),
+}
+
+# below this many usable rows a gradient fit is noise — emit the
+# base-rate constant model instead
+MIN_FIT_ROWS = 8
+
+
+def _plane_rows(rows: List[Dict], plane: str):
+    """(features-dict, label, truncated) triples for one plane's
+    trainable rows — resolved, labeled, action-matched."""
+    out = []
+    acts = PLANE_ACTIONS[plane]
+    for r in rows:
+        if r.get("plane") != plane or r.get("action") not in acts:
+            continue
+        regret = r.get("regret")
+        if not r.get("resolved") or regret is None:
+            continue  # no verdict: not a label
+        f = {k[2:]: v for k, v in r.items() if k.startswith("f.")}
+        out.append((f, bool(regret), bool(r.get("truncated"))))
+    return out
+
+
+def train_policy(dtrace: str, wtrace: Optional[str] = None,
+                 out_path: Optional[str] = None, seed: int = 0,
+                 horizon_clocks: int = 4,
+                 truncated_weight: float = 0.0) -> PolicyBundle:
+    """Fit all four plane models from a capture run's traces; returns
+    the bundle (written to `out_path` when given). Deterministic for
+    fixed inputs + seed."""
+    if not (0.0 <= truncated_weight <= 1.0):
+        raise ValueError(f"truncated_weight must be in [0, 1] "
+                         f"(got {truncated_weight}): forced-close "
+                         f"rows may be down-weighted, never "
+                         f"up-weighted — they are not labels")
+    from ..replay.dataset import export_dataset
+    ds = export_dataset(dtrace, wtrace, horizon_clocks=horizon_clocks)
+    planes: Dict[str, PlaneModel] = {}
+    train_meta: Dict[str, Dict] = {}
+    total_truncated = 0
+    for plane in sorted(PLANE_FEATURES):
+        triples = _plane_rows(ds["rows"], plane)
+        n_trunc = sum(1 for _, _, t in triples if t)
+        total_truncated += n_trunc
+        if truncated_weight == 0.0:
+            kept = [(f, y, 1.0) for f, y, t in triples if not t]
+        else:
+            kept = [(f, y, truncated_weight if t else 1.0)
+                    for f, y, t in triples]
+        n_pos = sum(1 for _, y, _ in kept if y)
+        meta = {"rows": len(triples), "truncated_rows": n_trunc,
+                "used": len(kept), "pos": n_pos}
+        if len(kept) < MIN_FIT_ROWS or n_pos in (0, len(kept)):
+            # too sparse or single-class: deterministic base rate
+            rate = n_pos / len(kept) if kept else 0.0
+            planes[plane] = PlaneModel.constant(
+                plane, rate, n_rows=len(kept), n_pos=n_pos)
+            meta["fit"] = "constant"
+        else:
+            from .features import vectorize
+            X = np.stack([vectorize(plane, f) for f, _, _ in kept])
+            y = np.array([1.0 if l else 0.0 for _, l, _ in kept])
+            w = np.array([wt for _, _, wt in kept])
+            mean, scale, beta, bias = fit_logistic(X, y, w)
+            planes[plane] = PlaneModel(plane, mean, scale, beta, bias,
+                                       n_rows=len(kept), n_pos=n_pos)
+            meta["fit"] = "logistic"
+        train_meta[plane] = meta
+    bundle = PolicyBundle(
+        {"seed": int(seed), "horizon_clocks": int(horizon_clocks),
+         "truncated_weight": float(truncated_weight),
+         "dtrace": dtrace, "wtrace": wtrace,
+         "dataset_rows": int(ds["n_rows"]),
+         "truncated_rows": int(total_truncated),
+         "train": train_meta}, planes)
+    if out_path:
+        bundle.save(out_path)
+    return bundle
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+    p = argparse.ArgumentParser(
+        prog="python -m adapm_tpu.policy.train",
+        description="Fit the per-plane learned policies from a capture "
+                    "run's decision (+ workload) traces.")
+    p.add_argument("dtrace", help=".dtrace from --sys.trace.decisions")
+    p.add_argument("wtrace", nargs="?", default=None,
+                   help="optional .wtrace from the SAME run")
+    p.add_argument("-o", "--out", required=True,
+                   help="policy artifact path (written atomically)")
+    p.add_argument("--seed", type=int, default=0,
+                   help="provenance seed recorded in the artifact "
+                        "(the fit itself consumes no RNG)")
+    p.add_argument("--horizon", type=int, default=4,
+                   help="w.* label window in logical clocks "
+                        "(default 4)")
+    p.add_argument("--truncated-weight", type=float, default=0.0,
+                   help="sample weight for forced-close rows "
+                        "(default 0.0 = excluded; forced outcomes "
+                        "are not labels)")
+    a = p.parse_args(argv)
+    b = train_policy(a.dtrace, a.wtrace, out_path=a.out, seed=a.seed,
+                     horizon_clocks=a.horizon,
+                     truncated_weight=a.truncated_weight)
+    t = b.meta["train"]
+    for plane in sorted(t):
+        m = t[plane]
+        print(f"{plane}: {m['fit']} fit from {m['used']}/{m['rows']} "
+              f"rows ({m['pos']} regretted, "
+              f"{m['truncated_rows']} truncated)")
+    print(f"policy.train.truncated_rows={b.meta['truncated_rows']} "
+          f"(weight {b.meta['truncated_weight']}) -> {a.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

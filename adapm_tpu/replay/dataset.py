@@ -16,6 +16,13 @@ did; obs/wtrace.py). `export_dataset` joins them into one flat
     learned policy would train against);
   - `regret` / `truncated` / `outcome_latency_s` from the attribution
     window (obs/decisions.py), None where a plane records no verdict;
+  - `truncated=true` rows are FORCED verdicts, not labels: close()
+    sealed the attribution window before its horizon elapsed, so the
+    outcome probe observed a shorter window than every other row.
+    Training consumers must down-weight or exclude them (the trainer's
+    `truncated_weight`, default 0.0, and the loud
+    `policy.train.truncated_rows` count — policy/train.py); the
+    artifact carries `n_truncated` so the bias is visible at export;
   - DETERMINISTIC bytes: same inputs => byte-identical JSON (sorted
     keys, fixed separators, no timestamps minted at export time —
     scripts/decision_quality_check.py pins the round-trip).
@@ -96,7 +103,7 @@ def export_dataset(dtrace: Union[str, DecisionTrace],
 
     outcomes = tr.outcomes()
     rows: List[Dict] = []
-    n_unresolved = n_regretted = 0
+    n_unresolved = n_regretted = n_truncated = 0
     for d in sorted(tr.decisions(), key=lambda e: e["seq"]):
         row: Dict = {"seq": d["seq"], "clock": d["clock"],
                      "plane": d["plane"], "action": d["action"]}
@@ -117,6 +124,8 @@ def export_dataset(dtrace: Union[str, DecisionTrace],
             row["resolved"] = True
             row["regret"] = oc.get("regret")
             row["truncated"] = bool(oc.get("truncated", False))
+            if row["truncated"]:
+                n_truncated += 1
             row["outcome_clock"] = oc["clock"]
             row["outcome_latency_s"] = round(oc["mono"] - d["mono"], 6)
             if row["regret"]:
@@ -140,6 +149,7 @@ def export_dataset(dtrace: Union[str, DecisionTrace],
         "n_rows": len(rows),
         "n_unresolved": n_unresolved,
         "n_regretted": n_regretted,
+        "n_truncated": n_truncated,
         "events_dropped_at_capture": int(tr.dropped),
         "columns": columns,
         "rows": rows,
@@ -176,7 +186,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     art = export_dataset(a.dtrace, a.wtrace, out_path=a.out,
                          horizon_clocks=a.horizon)
     print(f"{art['n_rows']} rows ({art['n_unresolved']} unresolved, "
-          f"{art['n_regretted']} regretted) x "
+          f"{art['n_regretted']} regretted, "
+          f"{art['n_truncated']} truncated) x "
           f"{len(art['columns'])} columns -> {a.out}")
     return 0
 

@@ -75,6 +75,12 @@ OBJECTIVES = {
     "dispatch_wait_p99_ms": "min",
     "shed_total": "min",
     "wall_s": "min",
+    # decision-regret rates (ISSUE 18 promotion gate): need
+    # score_decisions=True so the metrics-only recorder runs in replay
+    "regret_rate_reloc": "min",
+    "regret_rate_tier": "min",
+    "regret_rate_sync": "min",
+    "regret_rate_serve": "min",
 }
 
 # determinism pins a candidate may NOT override (module docstring):
@@ -168,7 +174,8 @@ class ReplayEngine:
 
     def __init__(self, trace, overrides: Optional[Dict] = None,
                  seed: int = 0, speed: float = 100.0,
-                 keep_deadlines: bool = False):
+                 keep_deadlines: bool = False,
+                 score_decisions: bool = False):
         if not isinstance(trace, WorkloadTrace):
             trace = load_wtrace(trace)  # raises WorkloadTraceError
         if speed <= 0:
@@ -180,6 +187,11 @@ class ReplayEngine:
         self.seed = int(seed)
         self.speed = float(speed)
         self.keep_deadlines = bool(keep_deadlines)
+        # ISSUE 18: attach a metrics-only DecisionRecorder (path=None)
+        # to the replayed server so `decision.regret_rate.<plane>`
+        # gauges score the re-decided decisions; the dtrace capture
+        # pin (trace_decisions=None) stays untouched
+        self.score_decisions = bool(score_decisions)
 
     # -- deterministic reconstruction ---------------------------------------
 
@@ -205,6 +217,12 @@ class ReplayEngine:
         srv = adapm_tpu.setup(int(trace.meta["num_keys"]),
                               trace.value_lengths, opts=opts,
                               num_shards=num_shards, num_workers=nw)
+        if self.score_decisions:
+            # metrics-only mode: windows/regret folding runs and the
+            # regret gauges land in snap["decision"]; flush() is a
+            # no-op so nothing is written (replay never captures)
+            from ..obs.decisions import DecisionRecorder
+            srv.decisions = DecisionRecorder(srv, None)
         digest = hashlib.sha256()
         workers: Dict[int, object] = {}
         sessions: Dict = {}
@@ -388,6 +406,7 @@ def extract_scores(snap: Dict, wall_s: float) -> Dict:
     sync = snap.get("sync", {})
     ex = snap.get("exec", {})
     pc = snap.get("plan_cache", {})
+    dec = snap.get("decision", {})
     hits = float(pc.get("hits", 0))
     misses = float(pc.get("misses", 0))
     shed = (serve.get("shed_total", 0) or 0) + \
@@ -406,6 +425,12 @@ def extract_scores(snap: Dict, wall_s: float) -> Dict:
         "dispatch_wait_p99_ms": _pct(ex, "dispatch_wait_s", 0.99),
         "plan_cache_hit_rate": round(hits / (hits + misses), 4)
         if (hits + misses) else None,
+        # present only with score_decisions=True (the metrics-only
+        # recorder); None otherwise, so regret objectives rank last
+        "regret_rate_reloc": dec.get("regret_rate.reloc"),
+        "regret_rate_tier": dec.get("regret_rate.tier"),
+        "regret_rate_sync": dec.get("regret_rate.sync"),
+        "regret_rate_serve": dec.get("regret_rate.serve"),
     }
 
 
@@ -423,7 +448,8 @@ def _auto_objective(results: Dict[str, Dict]) -> str:
 def rank_candidates(trace, candidates: Dict[str, Optional[Dict]],
                     objective: str = "auto", seed: int = 0,
                     speed: float = 100.0,
-                    out_path: Optional[str] = None) -> Dict:
+                    out_path: Optional[str] = None,
+                    score_decisions: bool = False) -> Dict:
     """Replay one trace under each candidate's knob overrides and emit
     the ranked comparison artifact (best first; deterministic name
     tie-break; runs missing the objective rank last). `candidates`
@@ -437,7 +463,7 @@ def rank_candidates(trace, candidates: Dict[str, Optional[Dict]],
     for name in sorted(candidates):
         results[name] = ReplayEngine(
             trace_obj, overrides=candidates[name], seed=seed,
-            speed=speed).run()
+            speed=speed, score_decisions=score_decisions).run()
     if objective == "auto":
         objective = _auto_objective(results)
     direction = OBJECTIVES.get(objective)

@@ -259,6 +259,16 @@ class LookupBatcher:
             if not reqs:
                 return  # empty (or closed): park until the next kick
             self._busy_since[lane] = time.monotonic()
+            pol = srv.policy
+            if pol is not None:
+                # ISSUE 18: how this batch's coalescing window closed
+                # — filled to max_batch (size-limited) or dispatched
+                # with room left when the window expired
+                # (window-limited). The live denominator the serve
+                # batch-window policy's shadow A/B reads against
+                # (docs/POLICY.md runbook); one `is None` check when
+                # the plane is off (the r7 skip-wrapper discipline).
+                pol.note_batch(len(reqs) < max_batch)
             try:
                 self._serve_batch(reqs)
             except (KeyboardInterrupt, SystemExit):

@@ -238,6 +238,22 @@ def ensure_hot_rows(server, store, shards: np.ndarray, slots: np.ndarray,
                                      demote_rows(store, s, victims))
             n += promote_rows(store, s, pc)
         if len(uc):
+            pol = server.policy
+            if pol is not None and pol.active("tier"):
+                # ISSUE 18 learned tier law: predicted
+                # promoted-never-hit regret HOLDS this shard's
+                # UNPINNED background promotions (the rows stay cold —
+                # served exactly from the cold pool, slower, never
+                # wrong, so no value-preservation guard is needed).
+                # Pinned candidates above and the force=True fused-step
+                # path are NEVER policy-gated: those promotions are
+                # intent/correctness driven, not speculative.
+                if pol.consult("tier", {"n_pinned": n_pinned,
+                                        "n_unpinned": n_unpinned},
+                               n_pinned + n_unpinned):
+                    pol.applied("tier")
+                    uc = uc[:0]
+        if len(uc):
             over = len(uc) - res.alloc.num_free(s)
             if over > 0:
                 uc = uc[np.argsort(-res.score[s, uc], kind="stable")]

@@ -23,7 +23,10 @@ per-core, scaled x64 for the paper's 8 nodes x 8 worker threads.
 vs_baseline = tpu_triples_per_sec / (64 * torch_cpu_per_core_triples_per_sec).
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "pm",
-"w2v_pairs_per_sec", "dedup"}.
+"w2v_pairs_per_sec", "dedup", ...}. The driver ALWAYS emits that line
+(even on a crash) and exits nonzero naming any failed phase — an
+artifact with dead phases must never be mistaken for a healthy run
+(ISSUE 18 satellite).
 
 Wedge-proofing (round 5): the driver process never imports jax. Every phase
 runs in a subprocess with a hard timeout (`--phase NAME` re-entry), and the
@@ -1117,6 +1120,92 @@ def bench_replay(E=8_000, vlen=16, steps=120, skew=8.0):
                               for n in candidates}}
 
 
+def bench_policy(E=1024, vlen=8, steps=80, skew=6.0):
+    """Learned-policy phase (ISSUE 18): capture the decision plane
+    under a deliberately starved hot pool (promotion under churn
+    evicts rows before they are re-touched, so most tier windows
+    resolve with regret), train the per-plane regret scorers offline
+    (adapm_tpu/policy), then replay the SAME workload A/B — heuristic
+    vs learned tier policy — scored by the decision-regret gauges
+    (`score_decisions=True`). The artifact carries the per-plane
+    training summary, both candidates' regret rates, the deltas, and
+    the value-preservation identity (both modes MUST fold the same
+    reads digest: a policy changes what/when, never values —
+    docs/POLICY.md)."""
+    import tempfile
+
+    import adapm_tpu
+    from adapm_tpu.config import SystemOptions
+    from adapm_tpu.policy import train_policy
+    from adapm_tpu.replay import (load_wtrace, per_shard_hot_rows,
+                                  rank_candidates)
+
+    with tempfile.TemporaryDirectory(prefix="adapm_policy_") as tmp:
+        wpath = os.path.join(tmp, "bench.wtrace")
+        dpath = os.path.join(tmp, "bench.dtrace")
+        ppath = os.path.join(tmp, "bench.policy.json")
+        tiny = max(8, per_shard_hot_rows(E, 0.05))
+        _progress(f"policy phase: capturing storm ({E} keys, {steps} "
+                  f"steps, starved hot pool {tiny} rows/shard)")
+        opts = SystemOptions(sync_max_per_sec=0, prefetch=False,
+                             tier=True, tier_hot_rows=tiny,
+                             trace_workload=wpath,
+                             trace_decisions=dpath)
+        srv = adapm_tpu.setup(E, vlen, opts=opts, num_workers=2)
+        w0, w1 = srv.make_worker(0), srv.make_worker(1)
+        w0.wait(w0.set(np.arange(E), np.ones((E, vlen), np.float32)))
+        rng = np.random.default_rng(29)
+        for i in range(steps):
+            w = w0 if i % 2 == 0 else w1
+            ks = np.unique((E * rng.random(24) ** skew)
+                           .astype(np.int64).clip(0, E - 1))
+            w.pull_sync(ks)
+            w.wait(w.push(ks, np.ones((len(ks), vlen), np.float32)))
+            if i % 4 == 0:
+                w.intent(ks, w.current_clock, w.current_clock + 4)
+                w.advance_clock()
+            srv.wait_sync()
+        srv.quiesce()
+        srv.shutdown()
+        tr = load_wtrace(wpath)
+        _progress("policy phase: training per-plane policies")
+        bundle = train_policy(dpath, wpath, out_path=ppath)
+        # A/B while the policy artifact still exists in the tempdir:
+        # the learned candidate flips ONLY the tier plane (holds
+        # background promotions — unconditionally value-preserving)
+        art = rank_candidates(
+            tr,
+            {"heuristic": {},
+             "learned": {"policy_tier": "learned",
+                         "policy_file": ppath}},
+            objective="regret_rate_tier", seed=7, speed=10.0,
+            score_decisions=True)
+    heur = art["candidates"]["heuristic"]
+    lrn = art["candidates"]["learned"]
+    regret_keys = ("regret_rate_reloc", "regret_rate_tier",
+                   "regret_rate_sync", "regret_rate_serve")
+    deltas = {k: (round(lrn["score"][k] - heur["score"][k], 4)
+                  if lrn["score"].get(k) is not None
+                  and heur["score"].get(k) is not None else None)
+              for k in regret_keys}
+    value_preserving = heur["reads_digest"] == lrn["reads_digest"]
+    _progress(f"policy phase: winner {art['winner']} (tier regret "
+              f"heuristic {heur['score']['regret_rate_tier']} vs "
+              f"learned {lrn['score']['regret_rate_tier']}), "
+              f"value_preserving={value_preserving}")
+    return {"train": bundle.meta["train"],
+            "dataset_rows": bundle.meta["dataset_rows"],
+            "truncated_rows": bundle.meta["truncated_rows"],
+            "winner": art["winner"],
+            "objective": art["objective"],
+            "regret": {"heuristic": {k: heur["score"][k]
+                                     for k in regret_keys},
+                       "learned": {k: lrn["score"][k]
+                                   for k in regret_keys}},
+            "regret_delta": deltas,
+            "value_preserving": bool(value_preserving)}
+
+
 def bench_tier(E=40_000, d=32, B=1024, steps=60, warmup=20,
                skew=16.0):
     """Tiered-storage phase (ISSUE 5): pull/push throughput of the
@@ -1761,6 +1850,16 @@ def _phase_replay():
     return out
 
 
+def _phase_policy():
+    import jax
+    sz = {"steps": 60} if os.environ.get("ADAPM_BENCH_SMALL") else {}
+    out = bench_policy(**sz)
+    out["virtual_shards"] = len(jax.devices("cpu"))
+    if sz:
+        out["small_sizes"] = sz
+    return out
+
+
 def _phase_w2v():
     if os.environ.get("ADAPM_BENCH_SMALL"):
         small = dict(V=20_000, d=64, B=2048, warmup=2)
@@ -1795,6 +1894,7 @@ _PHASES = {"probe": _phase_probe, "kge": _phase_kge,
            "tier": _phase_tier, "exec": _phase_exec,
            "episodic": _phase_episodic,
            "fault": _phase_fault, "replay": _phase_replay,
+           "policy": _phase_policy,
            "w2v": _phase_w2v, "cpu": _phase_cpu}
 
 # generous per-phase walls: a healthy phase finishes in a fraction of
@@ -1803,7 +1903,8 @@ _TIMEOUTS = {"probe": 120, "kge": 1200, "prefetch": 1200, "scan": 900,
              "dedup": 900, "pm": 900, "mgmt": 900, "compress": 900,
              "serve": 900, "bag": 900, "tier": 900, "exec": 900,
              "episodic": 900,
-             "fault": 900, "replay": 900, "w2v": 900, "cpu": 600}
+             "fault": 900, "replay": 900, "policy": 900, "w2v": 900,
+             "cpu": 600}
 
 _CPU_ENV = {"JAX_PLATFORMS": "cpu", "ADAPM_PLATFORM": "cpu",
             "ADAPM_BENCH_SMALL": "1"}
@@ -1963,6 +2064,10 @@ def main():
     # deterministic offline knob sweep are host-driven, and the
     # determinism digest must not depend on which backend ran it
     results["replay"] = _run_phase("replay", pm_env)
+    # learned-policy phase (ISSUE 18): host-CPU by design — the A/B is
+    # decided by deterministic replay, and the value-preservation
+    # digest identity must not depend on which backend ran it
+    results["policy"] = _run_phase("policy", pm_env)
     results["cpu"] = _run_phase("cpu")
 
     def phase_val(name, field):
@@ -2052,6 +2157,8 @@ def main():
                   else {"error": "fault failed"}),
         "replay": (results["replay"] if _ok(results["replay"])
                    else {"error": "replay failed"}),
+        "policy": (results["policy"] if _ok(results["policy"])
+                   else {"error": "policy failed"}),
         "w2v_pairs_per_sec": round(w2v, 1),
         "dedup": {"unique_batch_triples_per_sec": round(tput_unique, 1),
                   "gain_vs_skewed":
@@ -2076,6 +2183,15 @@ def main():
     if errs:
         out["phase_errors"] = errs
     print(json.dumps(out))
+    if errs:
+        # loud failure (ISSUE 18 satellite): the artifact above is
+        # still complete evidence, but a run with dead phases must not
+        # exit 0 — an outer harness once recorded `"parsed": null`
+        # artifacts from benches whose failures only lived in a nested
+        # phase_errors dict nothing looked at
+        _progress("FAILED phases: " + ", ".join(sorted(errs)))
+        return 1
+    return 0
 
 
 if __name__ == "__main__":
@@ -2090,4 +2206,14 @@ if __name__ == "__main__":
             jax.config.update("jax_platforms", _plat)
         print(json.dumps(_PHASES[sys.argv[2]]()))
     else:
-        main()
+        try:
+            rc = main()
+        except BaseException as e:
+            # the caller must ALWAYS get one parseable JSON line plus a
+            # nonzero rc — never a bare traceback it records as
+            # `"parsed": null` (ISSUE 18 satellite)
+            print(json.dumps({"metric": "kge_complex_train_throughput_pm",
+                              "value": 0.0,
+                              "error": f"driver crashed: {e!r}"}))
+            raise
+        sys.exit(rc)

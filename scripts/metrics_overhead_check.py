@@ -134,6 +134,21 @@ def main() -> int:
     print("[overhead-check] decision telemetry default-off: no "
           "recorder, zero decision.* names; decision sites are "
           "zero-cost skips")
+    # ISSUE 18: the learned-policy plane is compiled in but DEFAULT
+    # OFF — no PolicyPlane object, zero policy.* registry names, and
+    # every hook site (relocate batches, background tier promotion,
+    # dirty-mask sync filtering, SLO window moves, batcher close
+    # accounting) pays one `is None` check. The unchanged median-ratio
+    # guard below times the pull/push hot path with those branches
+    # present.
+    assert srv.policy is None, \
+        "learned policies must be DEFAULT OFF (--sys.policy.file unset)"
+    policy_names = [n for n in names if n.startswith("policy.")]
+    assert not policy_names, \
+        f"default-off policy plane registered metrics: {policy_names}"
+    print("[overhead-check] learned-policy plane default-off: no "
+          "PolicyPlane, zero policy.* names; hook sites are zero-cost "
+          "skips")
     saved = (w._h_pull, w._h_push, w._h_set, srv.sync._h_round)
     probe(w, batches, vals, 30)  # warm the jit caches
     # per-pair (off, on) timings back to back; the guard is the MEDIAN

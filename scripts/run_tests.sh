@@ -88,5 +88,11 @@ python scripts/portdiff_check.py
 # labeled dataset, and fold a strictly higher tier regret rate under a
 # thrashing (tiny) hot pool than under an ample one
 python scripts/decision_quality_check.py
+# learned-policy promotion gate (ISSUE 18): the same thrashing-pool
+# storm must train a byte-deterministic policy artifact whose learned
+# tier policy strictly beats the heuristic on replayed tier regret
+# while folding a bit-identical reads digest (a policy changes
+# what/when, never values)
+python scripts/policy_gate_check.py
 python -m pytest tests/ -q "$@"
 echo "ALL TESTS PASSED"

@@ -510,3 +510,64 @@ def test_decision_trace_knobs_round_trip_and_rejection():
     # non-integer values rejected by argparse itself
     with pytest.raises(SystemExit):
         p.parse_args(["--sys.trace.decisions_window", "soon"])
+
+
+def test_policy_knobs_round_trip_and_rejection():
+    """--sys.policy.{reloc,tier,sync,serve}/file/shadow (ISSUE 18):
+    parse into the options PolicyPlane consumes, everything defaults
+    OFF (no plane, zero policy.* names — pinned by tests/test_policy.py
+    and scripts/metrics_overhead_check.py); an unknown mode, an empty
+    artifact path, and learned/shadow without a file are each rejected
+    at parse time AND on hand-built options."""
+    import argparse
+
+    import pytest
+
+    from adapm_tpu.config import SystemOptions
+    p = argparse.ArgumentParser()
+    SystemOptions.add_arguments(p)
+    dflt = SystemOptions.from_args(p.parse_args([]))
+    assert dflt.policy_reloc == "heuristic"
+    assert dflt.policy_tier == "heuristic"
+    assert dflt.policy_sync == "heuristic"
+    assert dflt.policy_serve == "heuristic"
+    assert dflt.policy_file is None
+    assert dflt.policy_shadow is False
+    on = SystemOptions.from_args(p.parse_args(
+        ["--sys.policy.file", "/tmp/policy.json",
+         "--sys.policy.tier", "learned",
+         "--sys.policy.serve", "learned",
+         "--sys.policy.shadow", "1"]))
+    assert on.policy_file == "/tmp/policy.json"
+    assert on.policy_tier == "learned"
+    assert on.policy_serve == "learned"
+    assert on.policy_reloc == "heuristic"  # untouched planes stay off
+    assert on.policy_sync == "heuristic"
+    assert on.policy_shadow is True
+    # unknown mode rejected by argparse choices AND hand-built options
+    with pytest.raises(SystemExit):
+        p.parse_args(["--sys.policy.tier", "oracle"])
+    with pytest.raises(ValueError, match="policy.tier"):
+        SystemOptions(policy_tier="oracle",
+                      policy_file="/tmp/p.json").validate_serve()
+    # an empty artifact path can load nothing — rejected loudly
+    with pytest.raises(ValueError, match="policy.file"):
+        SystemOptions.from_args(p.parse_args(
+            ["--sys.policy.file", ""]))
+    with pytest.raises(ValueError, match="policy.file"):
+        SystemOptions(policy_file="").validate_serve()
+    # learned mode without an artifact has nothing to consult
+    with pytest.raises(ValueError, match="policy.file"):
+        SystemOptions.from_args(p.parse_args(
+            ["--sys.policy.sync", "learned"]))
+    with pytest.raises(ValueError, match="policy.file"):
+        SystemOptions(policy_sync="learned").validate_serve()
+    # shadow mode scores the trained policy — meaningless without one
+    with pytest.raises(ValueError, match="policy.shadow"):
+        SystemOptions.from_args(p.parse_args(
+            ["--sys.policy.shadow", "1"]))
+    with pytest.raises(ValueError, match="policy.shadow"):
+        SystemOptions(policy_shadow=True).validate_serve()
+    # non-integer shadow flag rejected by argparse itself
+    with pytest.raises(SystemExit):
+        p.parse_args(["--sys.policy.shadow", "maybe"])

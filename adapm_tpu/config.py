@@ -41,6 +41,18 @@ class SystemOptions:
     #    an earlier write future, so one thread could self-block)
     dcn_threads: int = 8
 
+    # -- transport plane (sys.net.*; adapm_tpu/net, docs/NETWORK.md):
+    #    backend selects the wire under GlobalPM — "auto" = the legacy
+    #    DCN channel (byte-identical pre-NetPort behavior), "tcp" = the
+    #    framed TcpNetPort, "loopback" = the in-process fabric (tests/
+    #    storms; normally injected via Server(net_node=...)); queue
+    #    bounds the loopback per-peer inbox; timeout_ms is the per-
+    #    attempt request timeout; heartbeat_ms paces membership beats
+    net_backend: str = "auto"
+    net_queue: int = 64
+    net_timeout_ms: float = 5000.0
+    net_heartbeat_ms: float = 100.0
+
     # -- sync throttling (sys.sync.*)
     sync_max_per_sec: float = 1000.0
     sync_pause_ms: float = 0.0
@@ -406,6 +418,22 @@ class SystemOptions:
                 "--sys.serve.slo_ms requires --sys.metrics: the SLO "
                 "controller observes the serve P99 from the "
                 "serve.latency_s histogram and is blind without it")
+        if self.net_backend not in ("auto", "dcn", "tcp", "loopback"):
+            raise ValueError(
+                f"--sys.net.backend must be one of auto/dcn/tcp/"
+                f"loopback (got {self.net_backend!r})")
+        if self.net_queue < 1:
+            raise ValueError(
+                f"--sys.net.queue must be >= 1 (got {self.net_queue}): "
+                f"a zero-bound peer inbox delivers nothing")
+        if self.net_timeout_ms <= 0:
+            raise ValueError(
+                f"--sys.net.timeout_ms must be > 0 "
+                f"(got {self.net_timeout_ms})")
+        if self.net_heartbeat_ms <= 0:
+            raise ValueError(
+                f"--sys.net.heartbeat_ms must be > 0 "
+                f"(got {self.net_heartbeat_ms})")
         from .tier.quant import COLD_DTYPES, SYNC_COMPRESS_MODES
         if self.tier_cold_dtype not in COLD_DTYPES:
             raise ValueError(
@@ -587,6 +615,15 @@ class SystemOptions:
                        type=float, default=0.0)
         g.add_argument("--sys.dcn_threads", dest="sys_dcn_threads",
                        type=int, default=8)
+        g.add_argument("--sys.net.backend", dest="sys_net_backend",
+                       type=str, default="auto")
+        g.add_argument("--sys.net.queue", dest="sys_net_queue",
+                       type=int, default=64)
+        g.add_argument("--sys.net.timeout_ms", dest="sys_net_timeout_ms",
+                       type=float, default=5000.0)
+        g.add_argument("--sys.net.heartbeat_ms",
+                       dest="sys_net_heartbeat_ms",
+                       type=float, default=100.0)
         g.add_argument("--sys.sync.max_per_sec", dest="sys_sync_max_per_sec",
                        type=float, default=1000.0)
         g.add_argument("--sys.sync.pause", dest="sys_sync_pause", type=float,
@@ -754,6 +791,10 @@ class SystemOptions:
             time_intent_actions=bool(args.sys_time_intent_actions),
             heartbeat_s=args.sys_heartbeat,
             dcn_threads=args.sys_dcn_threads,
+            net_backend=args.sys_net_backend,
+            net_queue=args.sys_net_queue,
+            net_timeout_ms=args.sys_net_timeout_ms,
+            net_heartbeat_ms=args.sys_net_heartbeat_ms,
             sync_max_per_sec=args.sys_sync_max_per_sec,
             sync_pause_ms=args.sys_sync_pause,
             sync_threshold=args.sys_sync_threshold,

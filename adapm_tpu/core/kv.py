@@ -83,7 +83,7 @@ class Server:
                  opts: Optional[SystemOptions] = None,
                  ctx: Optional[MeshContext] = None,
                  num_workers: Optional[int] = None,
-                 dtype=None):
+                 dtype=None, net_node=None):
         import jax.numpy as jnp
         self.opts = opts or SystemOptions()
         self.ctx = ctx or get_mesh_context()
@@ -105,9 +105,18 @@ class Server:
         key_class = np.searchsorted(uniq, self.value_lengths).astype(np.int32)
         class_counts = np.bincount(key_class, minlength=len(uniq))
 
-        from ..parallel import control
-        self.num_procs = control.num_processes()
-        self.pid = control.process_id()
+        # identity comes from the net node when one is injected (a
+        # LoopbackNode gives each in-process "node" its own rank; the
+        # default None -> DcnNode inside GlobalPM = the jax.distributed
+        # control plane, byte-identical to pre-NetPort behavior)
+        self._net_node = net_node
+        if net_node is not None:
+            self.num_procs = int(net_node.num_procs)
+            self.pid = int(net_node.pid)
+        else:
+            from ..parallel import control
+            self.num_procs = control.num_processes()
+            self.pid = control.process_id()
 
         # unified telemetry (adapm_tpu/obs; docs/OBSERVABILITY.md): the
         # metrics registry every subsystem below reports into, the
@@ -400,12 +409,22 @@ class Server:
         # snapshot might miss the write, breaking read-your-own-pushes
         # (pm.py _install_replicas)
         self._rw_pending: List = []
+        # transport-plane stats surface (net/membership.py): None on
+        # single-process AND dcn servers — the snapshot `net` section
+        # and net.* registry names exist only when a loopback/tcp node
+        # is attached (metrics_overhead_check.py pins default-off)
+        self.net = None
         if self.num_procs > 1:
             from ..parallel.pm import GlobalPM
-            self.glob = GlobalPM(self)
+            self.glob = GlobalPM(self, node=self._net_node)
+            node = self.glob.node
+            if hasattr(node, "bind"):
+                # loopback: attach the executor + fault plane to the
+                # port and start the membership beat thread
+                node.bind(self)
+            self.net = node.net_plane()
             if self.opts.heartbeat_s > 0:
-                from ..parallel import control
-                control.start_heartbeat(self.opts.heartbeat_s)
+                node.start_heartbeat(self.opts.heartbeat_s)
 
         self.sampling = None  # set by enable_sampling_support
         self._shutdown_done = False  # shutdown() is idempotent
@@ -1437,7 +1456,10 @@ class Server:
             self.stop_sync_thread()
         with self._span("collective.barrier"):
             self.block()
-            control.barrier()
+            if self.glob is not None:
+                self.glob.node.barrier()
+            else:
+                control.barrier()
         if was_running:
             self.start_sync_thread()
 
@@ -1453,7 +1475,10 @@ class Server:
 
     def dead_nodes(self, max_age_s: float = 10.0) -> list:
         """Peer processes whose heartbeat has gone stale (reference
-        Postoffice::GetDeadNodes; requires --sys.heartbeat > 0)."""
+        Postoffice::GetDeadNodes; requires --sys.heartbeat > 0). With a
+        net node attached, its membership plane is the authority."""
+        if self.glob is not None:
+            return self.glob.node.dead_peers(max_age_s)
         from ..parallel import control
         return control.dead_processes(max_age_s)
 
@@ -1557,8 +1582,7 @@ class Server:
         from ..obs import metrics as _obs_metrics
         _obs_metrics.clear_global_registry(self.obs)
         if self.glob is not None:
-            from ..parallel import control
-            control.stop_heartbeat()
+            self.glob.node.stop_heartbeat()
             self.glob.shutdown()
 
     def locality_summary(self) -> Dict[str, float]:
@@ -1634,7 +1658,8 @@ class Server:
                           "sync", "pm", "collective", "fused", "spans",
                           "serve", "tier", "exec", "flight", "slo",
                           "fault", "ckpt", "device", "episode",
-                          "wtrace", "replay", "decision", "policy")
+                          "wtrace", "replay", "decision", "policy",
+                          "net")
 
     def metrics_snapshot(self, drain_device: bool = True) -> Dict:
         """One structured, JSON-serializable telemetry dict for this
@@ -1782,8 +1807,19 @@ class Server:
         guard-blocked/agree/disagree, the loaded artifact path, and
         the serve batch-window close-reason tallies); `{}` when no
         `--sys.policy.file` is set (no PolicyPlane object, zero
-        policy.* names)."""
-        out: Dict = {"schema_version": 14,
+        policy.* names).
+
+        schema_version 15 (PR 19): always-present `net` section
+        (ISSUE 19; adapm_tpu/net) — the NetPort transport plane's
+        frame accounting (`msgs_out/in`, `bytes_out/in`, per-family
+        message counts, `retransmits`, `dup_suppressed`,
+        `decode_errors`, `dropped_frames`) and the membership plane's
+        peer states (`peers_live/dead/left/total`), beat/join/leave
+        tallies, and failover record (`failovers`, `failover_s`,
+        `promoted_keys`, `lost_keys`); `{}` on single-process and
+        legacy-DCN servers (no plane object, zero net.* names —
+        metrics_overhead_check.py pins default-off)."""
+        out: Dict = {"schema_version": 15,
                      "metrics_enabled": bool(self.obs.enabled)}
         for s in self._SNAPSHOT_SECTIONS:
             out[s] = {}
@@ -1826,6 +1862,8 @@ class Server:
                 out["collective"].update(
                     {f"bsp_{k}": int(v)
                      for k, v in self.glob.coll.stats.items()})
+        if self.net is not None:
+            out["net"].update(self.net.stats())
         if self.spans is not None:
             out["spans"].update(self.spans.stats())
         # executor occupancy/overlap summary rides with the registry's

@@ -47,6 +47,14 @@ Injection points wired in this tree:
     ckpt.restore    checkpoint restore entry, before any server
                     mutation (a failed restore leaves the live server
                     untouched)
+    net.send        NetPort outbound frame dropped at the sender
+                    (non-raising `draw`: the drop IS the fault; the
+                    port's retransmit machinery absorbs it)
+    net.recv        inbound frame dropped at the receiver (draw)
+    net.delay       outbound frame delayed ~5 ms (draw)
+    net.dup         outbound frame delivered twice — exercises the
+                    receiver's at-most-once rid dedup cache (draw)
+    net.partition   the (src, dst) link eats this frame (draw)
 """
 from __future__ import annotations
 
@@ -173,6 +181,24 @@ class FaultPlane:
             raise cls(
                 f"injected fault #{n} at {point!r} "
                 f"(--sys.fault.spec p={pt.prob:g}, seed={self.seed})")
+
+    def draw(self, point: str) -> bool:
+        """Non-raising evaluation for points where the fault is an
+        ACTION the caller performs (drop/duplicate/delay a network
+        frame, net/loopback.py) rather than an exception to unwind.
+        Same seeded per-point stream and accounting as fire()."""
+        pt = self._points.get(point)
+        if pt is None or pt.prob <= 0.0:
+            return False
+        with pt.lock:
+            pt.evals += 1
+            hit = pt.rng.random() < pt.prob
+            if hit:
+                pt.fired += 1
+        if hit:
+            self._c_fired.inc()
+            self._c_by_point[point].inc()
+        return hit
 
     def counts(self, point: str) -> Tuple[int, int]:
         """(evaluations, fired) for one point — 0s when unconfigured."""

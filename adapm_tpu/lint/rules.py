@@ -384,6 +384,11 @@ RAW_THREAD_ALLOWLIST = (
     "adapm_tpu/launcher.py",
     "adapm_tpu/parallel/dcn.py",
     "adapm_tpu/obs/reporter.py",
+    # the transport plane's threads are process-boundary I/O by nature
+    # (socket readers, membership beats that must outlive the executor
+    # into the teardown window, the loopback fallback drainer) — the
+    # same exemption the DCN van carries
+    "adapm_tpu/net/",
 )
 
 

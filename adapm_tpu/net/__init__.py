@@ -1,0 +1,36 @@
+"""NetPort transport plane (ISSUE 19; docs/NETWORK.md).
+
+The PM's cross-process traffic — sync deltas (in the r13 compressed
+wire format), relocations, ownership moves, serve forwards, and
+membership control — rides a narrow `NetPort` carrying versioned,
+checksummed frames. Three backends:
+
+  - `loopback.py` — in-process fabric: per-peer bounded FIFO queues
+    drained on the r11 executor's `net.<peer>` streams, so EVERY
+    multi-node path runs, storm-tests, and fault-drills in one
+    container, bit-identically to a single-process shadow.
+  - `socket.py` — the TCP backend, one class by construction: it adds
+    sockets to the frame/demux machinery the base class owns.
+  - the legacy DCN channel (parallel/dcn.py), wrapped by `DcnNode` —
+    the default for real multi-process launches, byte-identical to
+    pre-NetPort behavior.
+
+`membership.py` adds elastic shard join/leave and dead-peer failover
+(replica -> main promotion through `Server._topology_mutation`)."""
+from .port import (NetPort, NetNode, DcnNode, NetError, NetDecodeError,
+                   FrameTruncatedError, FrameChecksumError,
+                   FrameVersionError, FrameSpliceError, FrameFamilyError,
+                   NetTimeoutError, NetPeerDeadError,
+                   FAMILY_SYNC, FAMILY_RELOC, FAMILY_OWNER, FAMILY_SERVE,
+                   FAMILY_CTRL, WIRE_VERSION)
+from .loopback import LoopbackFabric, LoopbackNode, LoopbackCluster
+from .membership import Membership
+
+__all__ = [
+    "NetPort", "NetNode", "DcnNode", "NetError", "NetDecodeError",
+    "FrameTruncatedError", "FrameChecksumError", "FrameVersionError",
+    "FrameSpliceError", "FrameFamilyError", "NetTimeoutError",
+    "NetPeerDeadError", "FAMILY_SYNC", "FAMILY_RELOC", "FAMILY_OWNER",
+    "FAMILY_SERVE", "FAMILY_CTRL", "WIRE_VERSION", "LoopbackFabric",
+    "LoopbackNode", "LoopbackCluster", "Membership",
+]

@@ -31,6 +31,10 @@ subsystem has activity, always in this order:
                                                `shadow_dis=<n>` rides
                                                along when shadow mode
                                                disagreed (ISSUE 18)
+    net=<msgs>/<bytes> peers=<live>/<total>    transport-plane frames
+                                               sent + peer liveness
+                                               once a NetPort is
+                                               attached (ISSUE 19)
 
 Ratios are 2-decimal, latencies 2-decimal milliseconds."""
 from __future__ import annotations
@@ -101,6 +105,15 @@ def _fmt(snap: dict) -> str:
                      f"/{po['consults_total']}")
         if po.get("shadow_disagree"):
             parts.append(f"shadow_dis={po['shadow_disagree']}")
+    # transport plane: frames sent + peer liveness once a NetPort is
+    # attached (ISSUE 19); absent by default — the net.* names only
+    # register when a membership plane exists (loopback/tcp node)
+    nt = snap.get("net", {})
+    if nt.get("msgs_out") or nt.get("msgs_in"):
+        parts.append(f"net={nt.get('msgs_out', 0)}"
+                     f"/{nt.get('bytes_out', 0)} "
+                     f"peers={nt.get('peers_live', 0)}"
+                     f"/{nt.get('peers_total', 0)}")
     return " ".join(parts) or "no activity yet"
 
 

@@ -118,10 +118,18 @@ def _fill_flat(out: np.ndarray, offs: np.ndarray, lens: np.ndarray,
 class GlobalPM:
     """One per Server when `jax.process_count() > 1`."""
 
-    def __init__(self, server):
+    def __init__(self, server, node=None):
         self.server = server
-        self.pid = control.process_id()
-        self.num_procs = control.num_processes()
+        # The node abstraction (net/port.py NetNode): identity, channel
+        # factory, barriers, liveness. Default DcnNode = byte-identical
+        # pre-NetPort behavior; a LoopbackNode runs the SAME code paths
+        # fully in-process (tests, storms, failover drills).
+        if node is None:
+            from ..net.port import DcnNode
+            node = DcnNode(server.opts)
+        self.node = node
+        self.pid = node.pid
+        self.num_procs = node.num_procs
         assert self.num_procs <= 64, \
             "interest bitmask is uint64 (one bit per process)"
         self._gs = server.num_shards * self.num_procs
@@ -193,8 +201,7 @@ class GlobalPM:
         # --sys.dcn_threads (reference --sys.zmq_threads analog), which
         # also sizes the channel's serve pool (handler concurrency)
         nr, nw = executor_widths(server.opts)
-        self.chan = DcnChannel(self.pid, self.num_procs, self._handle,
-                               serve_threads=nr)
+        self.chan = node.make_channel(self._handle, serve_threads=nr)
         self.chan.start()
         self._exec_r = ThreadPoolExecutor(max_workers=nr,
                                           thread_name_prefix="adapm-pm-r")
@@ -211,9 +218,14 @@ class GlobalPM:
         # points instead of DCN RPC (parallel/collective.py)
         self.coll = None
         if server.opts.collective_sync:
+            if node.kind != "dcn":
+                raise ValueError(
+                    "--sys.collective_sync requires the dcn backend "
+                    "(device collectives are meaningless on the "
+                    f"in-process {node.kind!r} fabric)")
             from .collective import CollectiveSync
             self.coll = CollectiveSync(self, server.opts.collective_bucket)
-        control.barrier("pm-up")
+        node.barrier("pm-up")
 
     @contextmanager
     def delta_window(self, channels=None):
@@ -755,6 +767,66 @@ class GlobalPM:
             # unsubscribe so they stay relocatable
             self.unsub(np.concatenate(surplus))
 
+    def failover_dead_peer(self, dead: int):
+        """Dead-peer failover (net/membership.py drives this exactly
+        once per death): promote every LOCAL replica of a key the dead
+        rank owned to a main copy via the same replica->owner upgrade
+        relocation uses (_adopt — pending sync deltas merge, counters
+        bump, addressbook adopts under _topology_mutation). Keys the
+        corpse owned with no replica here are LOST: their owner hint
+        keeps pointing at the corpse, so reads fail fast with
+        NetPeerDeadError instead of hanging. Returns (promoted, lost).
+
+        Lock order: delta locks (channel order) -> server._lock, same
+        as every other delta consumer — the beat thread that calls this
+        holds nothing else, so the sentinel stays green."""
+        srv = self.server
+        keys_all = np.arange(srv.num_keys, dtype=np.int64)
+        home = self.home_proc(keys_all)
+        # believed owned by the corpse: an explicit hint, or unlearned
+        # keys whose manager is the corpse (hint still at NOT_CACHED)
+        dead_owned = (self.owner_hint == dead) | \
+            ((self.owner_hint == NOT_CACHED) & (home == dead))
+        promoted = 0
+        with srv._lock:
+            # stop sync rounds from shipping deltas at the corpse
+            self.interest &= ~np.uint64(1 << dead)
+            ab = srv.ab
+            cand = keys_all[dead_owned & (ab.owner[keys_all] < 0)]
+            # shard hosting each candidate's replica (-1 = none = lost)
+            rep_shard = np.full(len(cand), -1, np.int32)
+            for s in range(srv.num_shards):
+                has = (rep_shard < 0) & (ab.cache_slot[s, cand] >= 0)
+                rep_shard[has] = s
+        for s in range(srv.num_shards):
+            keys = cand[rep_shard == s]
+            if len(keys) == 0:
+                continue
+            lens = srv.value_lengths[keys]
+            offs = _offsets(lens)
+            flat = np.zeros(offs[-1], dtype=np.float32)
+            with self.delta_window_for(keys):
+                # replica BASE rows under the delta window: an in-flight
+                # refresh (which holds these locks across its round
+                # trip) can never land between this read and the adopt
+                with srv._lock:
+                    for cid, pos in srv._group_by_class(keys):
+                        ks = keys[pos]
+                        cs = ab.cache_slot[s, ks]
+                        live = cs >= 0
+                        if not live.any():
+                            continue
+                        rows = srv.stores[cid].read_rows(
+                            "cache", np.full(int(live.sum()), s,
+                                             np.int32),
+                            cs[live].astype(np.int32))
+                        _fill_flat(flat, offs, lens, pos[live],
+                                   rows.ravel())
+                self._adopt(keys, flat, self.reloc[keys] + 1, int(s))
+            promoted += len(keys)
+        lost = int((rep_shard < 0).sum())
+        return promoted, lost
+
     # -- cross-process sync rounds ------------------------------------------
 
     def _serve_sync(self, msg):
@@ -1130,9 +1202,13 @@ class GlobalPM:
         # 2. drain our own outbound executors: peers still serve, their
         #    channels stay open until step 3.
         # 3. down barrier, then close the channel.
-        control.barrier("pm-pre-down")
+        # Step 0 (loopback): announce a graceful leave FIRST, so peers'
+        # membership planes mark this rank `left` — its beats stopping
+        # during teardown must never read as a death (no-op on DCN).
+        self.node.pre_down()
+        self.node.barrier("pm-pre-down")
         self._exec_r.shutdown(wait=True)
         self._exec_w.shutdown(wait=True)
         self._exec_fan.shutdown(wait=True)
-        control.barrier("pm-down")
+        self.node.barrier("pm-down")
         self.chan.shutdown()

@@ -1,12 +1,19 @@
 """Liveness/readiness surface for the serving plane.
 
 Per docs/failure_handling.md, heartbeats (`--sys.heartbeat`) and
-`Server.dead_nodes()` are DETECTION-ONLY: a stale peer is reported, not
-replaced. The serve plane folds that detection into a readiness signal
-a load balancer can act on — a process with stale peers (its lookups
-may observe arbitrarily stale replicas of remotely-owned keys, and
-cross-process pulls may block on a dead owner) reports not-ready while
-continuing to serve in-flight and local traffic; nothing hangs.
+`Server.dead_nodes()` were DETECTION-ONLY through r18: a stale peer is
+reported, not replaced. With a NetPort membership plane attached
+(ISSUE 19; adapm_tpu/net), detection becomes ACTION: the membership
+plane promotes the dead peer's locally-replicated keys to mains
+(GlobalPM.failover_dead_peer) and `dead_nodes()` reports through it, so
+readiness here reflects post-failover truth — a peer stays in the
+stale list only while its keys are actually unreachable, and the
+embedded `failover` detail (see readiness()) records what the plane
+did about it. On legacy DCN servers the contract is unchanged:
+detection-only, a process with stale peers (its lookups may observe
+arbitrarily stale replicas of remotely-owned keys, and cross-process
+pulls may block on a dead owner) reports not-ready while continuing to
+serve in-flight and local traffic; nothing hangs.
 
 Readiness folds four signals:
   - the dispatch plane is running (a dead dispatcher serves nothing);
@@ -134,16 +141,37 @@ class HealthMonitor:
                 f"program > {self.server.opts.fault_watchdog_s:.0f}s "
                 f"(--sys.fault.watchdog_s)")
         dead = self._dead()
+        # failover detail (ISSUE 19): when a membership plane exists,
+        # report what the plane DID about the dead peers — promoted
+        # replica counts, lost keys, recovery wall — next to the raw
+        # detection signal (None on detection-only/legacy servers)
+        net = getattr(self.server, "net", None)
+        failover = None
+        if net is not None:
+            s = net.stats()
+            failover = {"failovers": s["failovers"],
+                        "failover_s": s["failover_s"],
+                        "promoted_keys": s["promoted_keys"],
+                        "lost_keys": s["lost_keys"],
+                        "peers_live": s["peers_live"],
+                        "peers_total": s["peers_total"]}
         if dead:
-            reasons.append(
-                f"stale peer heartbeats (detection-only, "
-                f"docs/failure_handling.md): {dead}")
+            if failover is not None:
+                reasons.append(
+                    f"dead peers {dead}: failover promoted "
+                    f"{failover['promoted_keys']} replica key(s), "
+                    f"{failover['lost_keys']} lost "
+                    f"(docs/NETWORK.md)")
+            else:
+                reasons.append(
+                    f"stale peer heartbeats (detection-only, "
+                    f"docs/failure_handling.md): {dead}")
         out = {"ready": not reasons, "reasons": reasons,
                "dead_nodes": dead, "queue_depth": depth,
                "queue_bound": bound,
                "dispatchers": batcher.dispatchers,
                "wedged_dispatchers": wedged,
                "wedged_streams": [w["stream"] for w in exw],
-               "degraded": degraded}
+               "degraded": degraded, "failover": failover}
         self._cache = (time.monotonic(), out)
         return out

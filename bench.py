@@ -1875,6 +1875,98 @@ def _phase_w2v():
             "scan_gain": round(scan8 / per_step - 1.0, 3)}
 
 
+def bench_net(E=2_048, L=16, rounds=4, batch=256):
+    """NetPort loopback transport (ISSUE 19; docs/NETWORK.md): two full
+    Servers in one process wired through the loopback fabric. Measures
+    cross-node push/sync wire throughput under injected wire faults
+    (drop/dup/delay — the retransmit + dedup machinery pays its way or
+    shows up here), then kills one node and records the dead-peer
+    failover wall (detection -> replicas promoted = net.failover_s)."""
+    import numpy as np
+
+    from adapm_tpu.base import CLOCK_MAX
+    from adapm_tpu.config import SystemOptions
+    from adapm_tpu.net import LoopbackCluster
+
+    cl = LoopbackCluster(
+        2, num_keys=E, value_lengths=L,
+        opts_factory=lambda r: SystemOptions(
+            sync_max_per_sec=0, prefetch=False,
+            fault_spec="net.send=0.02,net.recv=0.02,net.dup=0.05"),
+        heartbeat_ms=40.0)
+    allk = np.arange(E, dtype=np.int64)
+
+    def prep(rank, srv):
+        w = srv.make_worker(0)
+        if rank == 0:
+            w.wait(w.set(allk, np.zeros((E, L), np.float32)))
+        srv.barrier()
+        theirs = allk[srv.glob.home_proc(allk) == 1]
+        if rank == 1:
+            w.intent(theirs, 0, CLOCK_MAX)
+            srv.wait_sync()
+        srv.barrier()
+        if rank == 0:
+            w.intent(theirs, 0, CLOCK_MAX)
+            srv.wait_sync()
+        srv.barrier()
+
+    cl.run(prep)
+
+    def storm(rank, srv):
+        w = srv.make_worker(0)
+        rng = np.random.default_rng(100 + rank)
+        for _ in range(rounds):
+            keys = np.sort(rng.choice(E, size=batch,
+                                      replace=False)).astype(
+                np.int64)
+            vals = rng.integers(-4, 5, size=(batch, L)).astype(
+                np.float32)
+            w.wait(w.push(keys, vals))
+            srv.wait_sync()
+            srv.barrier()
+        return None
+
+    t0 = time.perf_counter()
+    cl.run(storm)
+    storm_s = time.perf_counter() - t0
+    s = cl.servers[0].net.stats()
+    wire_msgs = s["msgs_out"] + s["msgs_in"]
+    wire_bytes = s["bytes_out"] + s["bytes_in"]
+
+    srv0 = cl.servers[0]
+    cl.kill(1)
+    deadline = time.monotonic() + 30.0
+    while time.monotonic() < deadline and \
+            srv0.net.stats()["failovers"] == 0:
+        time.sleep(0.02)
+    f = srv0.net.stats()
+    out = {
+        "storm_s": round(storm_s, 3),
+        "push_keys_per_s": round(2 * rounds * batch / storm_s),
+        "wire_msgs_per_s": round(wire_msgs / storm_s),
+        "wire_mb_per_s": round(wire_bytes / storm_s / 1e6, 2),
+        "retransmits": s["retransmits"],
+        "dup_suppressed": s["dup_suppressed"],
+        "failover_s": round(f["failover_s"], 4),
+        "promoted_keys": f["promoted_keys"],
+        "lost_keys": f["lost_keys"],
+    }
+    cl.shutdown(ranks=[0])
+    return out
+
+
+def _phase_net():
+    import jax
+    sz = {"E": 512, "rounds": 2, "batch": 64} \
+        if os.environ.get("ADAPM_BENCH_SMALL") else {}
+    out = bench_net(**sz)
+    out["virtual_shards"] = len(jax.devices("cpu"))
+    if sz:
+        out["small_sizes"] = sz
+    return out
+
+
 def _phase_cpu():
     # measured per-core CPU throughput of a strong batched torch
     # implementation of the same step; the paper's 8-node x 8-thread
@@ -1893,7 +1985,8 @@ _PHASES = {"probe": _phase_probe, "kge": _phase_kge,
            "bag": _phase_bag,
            "tier": _phase_tier, "exec": _phase_exec,
            "episodic": _phase_episodic,
-           "fault": _phase_fault, "replay": _phase_replay,
+           "fault": _phase_fault, "net": _phase_net,
+           "replay": _phase_replay,
            "policy": _phase_policy,
            "w2v": _phase_w2v, "cpu": _phase_cpu}
 
@@ -1903,8 +1996,8 @@ _TIMEOUTS = {"probe": 120, "kge": 1200, "prefetch": 1200, "scan": 900,
              "dedup": 900, "pm": 900, "mgmt": 900, "compress": 900,
              "serve": 900, "bag": 900, "tier": 900, "exec": 900,
              "episodic": 900,
-             "fault": 900, "replay": 900, "policy": 900, "w2v": 900,
-             "cpu": 600}
+             "fault": 900, "net": 900, "replay": 900, "policy": 900,
+             "w2v": 900, "cpu": 600}
 
 _CPU_ENV = {"JAX_PLATFORMS": "cpu", "ADAPM_PLATFORM": "cpu",
             "ADAPM_BENCH_SMALL": "1"}
@@ -2060,6 +2153,10 @@ def main():
     # robustness phase (ISSUE 10): host-CPU by design — incremental
     # checkpoint bytes and recovery wall time are host serialization
     results["fault"] = _run_phase("fault", pm_env)
+    # transport phase (ISSUE 19): host-CPU by design — two loopback
+    # nodes in one process; records storm wire throughput under
+    # injected faults and the dead-peer failover wall (net.failover_s)
+    results["net"] = _run_phase("net", pm_env)
     # trace-replay phase (ISSUE 15): host-CPU by design — capture +
     # deterministic offline knob sweep are host-driven, and the
     # determinism digest must not depend on which backend ran it

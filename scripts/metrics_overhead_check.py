@@ -149,6 +149,19 @@ def main() -> int:
     print("[overhead-check] learned-policy plane default-off: no "
           "PolicyPlane, zero policy.* names; hook sites are zero-cost "
           "skips")
+    # ISSUE 19: the NetPort transport plane is compiled in but DEFAULT
+    # OFF — a single-process server attaches NO net node/membership
+    # plane (srv.net is None), registers zero net.* names, and the
+    # snapshot `net` section stays empty. The loopback/tcp backends
+    # exist only when a NetNode is passed at construction.
+    assert srv.net is None, \
+        "NetPort membership plane must be DEFAULT OFF (no net_node)"
+    net_names = [n for n in names if n.startswith("net.")]
+    assert not net_names, \
+        f"default-off net plane registered metrics: {net_names}"
+    print("[overhead-check] net transport plane default-off: no "
+          "membership plane, zero net.* names; the dcn/legacy path is "
+          "byte-identical")
     saved = (w._h_pull, w._h_push, w._h_set, srv.sync._h_round)
     probe(w, batches, vals, 30)  # warm the jit caches
     # per-pair (off, on) timings back to back; the guard is the MEDIAN

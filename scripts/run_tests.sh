@@ -94,5 +94,12 @@ python scripts/decision_quality_check.py
 # while folding a bit-identical reads digest (a policy changes
 # what/when, never values)
 python scripts/policy_gate_check.py
+# NetPort transport drill (ISSUE 19): a seeded two-node loopback storm
+# under injected frame drop/dup/delay/partition must read bit-identical
+# to an uninjected single-process shadow after every quiesce (lock-order
+# sentinel armed); killing one node mid-storm must promote its replicas
+# to mains within the bounded, recorded net.failover_s and the survivor
+# must keep serving the covered keys bit-exactly
+python scripts/net_storm_check.py
 python -m pytest tests/ -q "$@"
 echo "ALL TESTS PASSED"

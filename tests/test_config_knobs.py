@@ -571,3 +571,37 @@ def test_policy_knobs_round_trip_and_rejection():
     # non-integer shadow flag rejected by argparse itself
     with pytest.raises(SystemExit):
         p.parse_args(["--sys.policy.shadow", "maybe"])
+
+
+def test_net_knobs_round_trip_and_rejection():
+    """--sys.net.{backend,queue,timeout_ms,heartbeat_ms} parse into
+    the options the NetPort backends consume, with bad values failing
+    loudly at parse time (ISSUE 19 satellite)."""
+    import argparse
+
+    import pytest
+
+    from adapm_tpu.config import SystemOptions
+    p = argparse.ArgumentParser()
+    SystemOptions.add_arguments(p)
+    dflt = SystemOptions.from_args(p.parse_args([]))
+    assert (dflt.net_backend, dflt.net_queue, dflt.net_timeout_ms,
+            dflt.net_heartbeat_ms) == ("auto", 64, 5000.0, 100.0)
+    on = SystemOptions.from_args(p.parse_args(
+        ["--sys.net.backend", "tcp", "--sys.net.queue", "128",
+         "--sys.net.timeout_ms", "750", "--sys.net.heartbeat_ms",
+         "40"]))
+    assert on.net_backend == "tcp" and on.net_queue == 128
+    assert on.net_timeout_ms == 750.0 and on.net_heartbeat_ms == 40.0
+    bad = (["--sys.net.backend", "carrier-pigeon"],
+           ["--sys.net.queue", "0"],
+           ["--sys.net.timeout_ms", "0"],
+           ["--sys.net.heartbeat_ms", "-5"])
+    for argv in bad:
+        with pytest.raises(ValueError):
+            SystemOptions.from_args(p.parse_args(argv))
+    # hand-built options are validated again at server construction
+    with pytest.raises(ValueError, match="net.backend"):
+        SystemOptions(net_backend="ipx").validate_serve()
+    with pytest.raises(ValueError, match="net.queue"):
+        SystemOptions(net_queue=-1).validate_serve()

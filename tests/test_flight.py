@@ -683,13 +683,19 @@ def test_reporter_line_format():
                           "serve=4 p50=0.50ms p99=0.99ms "
                           "overlap=0.25 hot_hit=0.90 "
                           "fresh=1.98ms regret=0.25")
+    # net part (ISSUE 19): msgs/bytes + live/total peers, appended last
+    snap["net"] = {"msgs_out": 12, "bytes_out": 3456,
+                   "peers_live": 2, "peers_total": 3}
+    assert _fmt(snap).endswith(" net=12/3456 peers=2/3")
     # a subsystem with no activity contributes nothing (no empty fields)
     assert _fmt({"serve": {"latency_s": {"count": 0}},
                  "exec": {"programs_total": 0},
                  "tier": {"hot_hits": 0, "cold_hits": 0},
                  "flight": {"freshness_s": {"count": 0}},
                  "decision": {"events_total": 0,
-                              "regret_rate.tier": 0.0}}) \
+                              "regret_rate.tier": 0.0},
+                 "net": {"msgs_out": 0, "msgs_in": 0,
+                         "peers_live": 1, "peers_total": 1}}) \
         == "no activity yet"
 
 

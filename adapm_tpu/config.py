@@ -14,6 +14,85 @@ from typing import Optional
 from .base import MgmtTechniques
 
 
+def parse_class_targets(base_ms: float, spec: str,
+                        flag: str = "--sys.serve.slo_ms"):
+    """Parse a per-priority-class target spec — comma-separated
+    "prio=ms" pairs, e.g. "1=10,0=50" — into {priority: target_ms}.
+    Empty spec -> {} (the byte-identical no-override path). Raises
+    ValueError on a malformed pair, a negative priority, a non-positive
+    target, a duplicate class, or overrides without a base target
+    (ISSUE 20 satellite; the flag itself carries "base,prio=ms,...",
+    split by `from_args`)."""
+    out = {}
+    if not spec:
+        return out
+    if base_ms <= 0:
+        raise ValueError(
+            f"{flag}: per-class overrides ({spec!r}) require a base "
+            f"target > 0 — classes without an override fall back to "
+            f"the base, which must therefore exist")
+    for part in spec.split(","):
+        part = part.strip()
+        cls_s, eq, val_s = part.partition("=")
+        if not eq or not cls_s or not val_s:
+            raise ValueError(
+                f"{flag}: malformed per-class override {part!r} "
+                f"(expected 'priority=target_ms', e.g. '1=10')")
+        try:
+            cls = int(cls_s)
+            val = float(val_s)
+        except ValueError:
+            raise ValueError(
+                f"{flag}: malformed per-class override {part!r} "
+                f"(priority must be an int, target a float)") from None
+        if cls < 0:
+            raise ValueError(
+                f"{flag}: priority class must be >= 0 (got {cls})")
+        if val <= 0:
+            raise ValueError(
+                f"{flag}: per-class target must be > 0 ms "
+                f"(got {val:g} for class {cls})")
+        if cls in out:
+            raise ValueError(
+                f"{flag}: duplicate override for class {cls}")
+        out[cls] = val
+    return out
+
+
+def _slo_spec(text: str) -> str:
+    """argparse type for SLO flags that accept "base_ms" or
+    "base_ms,prio=ms,...": syntax-checks at parse time (range and
+    consistency checks live in validate_serve) and returns the raw
+    string for from_args to split."""
+    head, _, rest = text.partition(",")
+    try:
+        float(head)
+    except ValueError:
+        raise argparse.ArgumentTypeError(
+            f"expected 'target_ms' or 'target_ms,prio=ms,...' "
+            f"(got {text!r})") from None
+    for part in rest.split(",") if rest else ():
+        cls_s, eq, val_s = part.strip().partition("=")
+        ok = bool(eq)
+        if ok:
+            try:
+                int(cls_s)
+                float(val_s)
+            except ValueError:
+                ok = False
+        if not ok:
+            raise argparse.ArgumentTypeError(
+                f"malformed per-class override {part!r} in {text!r} "
+                f"(expected 'priority=target_ms')")
+    return text
+
+
+def _split_slo_spec(text: str):
+    """"25,1=10" -> (25.0, "1=10"); "25" -> (25.0, "")."""
+    head, _, rest = str(text).partition(",")
+    return float(head), rest
+
+
 @dataclasses.dataclass
 class SystemOptions:
     """Knobs for the parameter manager (reference coloc_kv_server.h:205-222,
@@ -221,6 +300,13 @@ class SystemOptions:
     # flight trace output path
     # (default: <stats_out or cwd>/flight.<rank>.trace.json)
     trace_flight_out: Optional[str] = None
+    # freshness-probe table bound (ISSUE 20 satellite): how many
+    # in-flight push-to-servable probes the FreshnessProbe may hold
+    # before evicting the oldest unresolved one. The pre-r22 hardcoded
+    # bound (256) was fine for a spot gauge but too noisy as an SLO
+    # input — at-bound eviction silently drops the probes a controller
+    # steers by. >= 8; raise further for high-fanout streams.
+    flight_freshness_samples: int = 1024
     # workload trace capture (ISSUE 15; obs/wtrace.py, docs/REPLAY.md):
     # record the semantic op stream — pull/push/set key batches, intent
     # windows, clock advances, serve lookups with tenant/priority/
@@ -279,7 +365,19 @@ class SystemOptions:
     # the hand-tuned static window. When unset, serve behavior is
     # IDENTICAL to the static-knob path (no controller exists).
     # Requires --sys.metrics (the controller reads the histogram).
+    # The CLI flag also accepts per-priority-class overrides:
+    # "25,1=10,0=50" sets the base target to 25 ms, class 1 (gold) to
+    # 10 ms, class 0 (bronze) to 50 ms — parsed into serve_slo_class
+    # below.
     serve_slo_ms: float = 0.0
+    # per-priority-class SLO overrides (ISSUE 20 satellite; first
+    # slice of ROADMAP item 4): "prio=ms" pairs, comma-separated
+    # ("1=10,0=50"). With any override set the SLO controller keeps a
+    # per-class effective batch window (batcher.class_wait_us) and
+    # walks each class's window against ITS target from per-class
+    # windowed P99s; empty (the default) leaves the single-window path
+    # byte-identical to pre-r22. Requires serve_slo_ms > 0.
+    serve_slo_class: str = ""
     # dispatcher drains (ISSUE 9 tentpole b; serve/batcher.py): N
     # admission lanes, each drained by its own executor stream
     # (`serve`, `serve.1`, ...), so a long-row length class's gather no
@@ -305,6 +403,43 @@ class SystemOptions:
     # gather; bit-identical either way (the knob moves WHERE the
     # reduction runs, never what it returns).
     serve_bags: bool = True
+
+    # -- streaming plane (sys.stream.*; adapm_tpu/stream,
+    #    docs/STREAMING.md): the PM as a continuously-trained online
+    #    service — a micro-batching StreamTrainer turning click events
+    #    into fused Push steps while ServeSessions read, plus a
+    #    FreshnessSLO controller closing the loop on event-to-servable
+    #    staleness. With NO stream knob set the Server holds no stream
+    #    plane object and the registry holds zero stream.* names (the
+    #    r7 skip-wrapper discipline; scripts/metrics_overhead_check.py
+    #    pins it).
+    # events per fused push micro-batch (the trainer's unit of work AND
+    # its ack/checkpoint granularity — the acked-event cursor only
+    # advances at batch boundaries). 0 (default) = no trainer support;
+    # > 0 turns the stream plane on.
+    stream_batch: int = 0
+    # target ingest rate in events/s for the executor pump (0 =
+    # unthrottled: each micro-batch is pushed as soon as the previous
+    # one finishes). Requires stream_batch > 0.
+    stream_rate: float = 0.0
+    # event-to-servable freshness SLO target in ms (0 = off). When set,
+    # a FreshnessSLO controller (stream/freshness.py) observes the
+    # windowed P99 of flight.freshness_s and walks TWO levers — the
+    # effective sync rate (sync.effective_max_per_sec above the static
+    # --sys.sync.max_per_sec throttle) and the effective serve-replica
+    # refresh window (ServeReplica.refresh_s below the static
+    # --sys.serve.replica_refresh_ms) — with the obs/slo.py law:
+    # multiplicative shrink/grow, deadband hysteresis, hard bounds,
+    # bounded move log. Requires --sys.trace.flight (the freshness
+    # probe is the sensor) and --sys.metrics. The CLI flag accepts the
+    # same per-class override syntax as --sys.serve.slo_ms
+    # ("400,1=200"): the controller steers to the TIGHTEST class
+    # target (freshness is a write-path property shared by all
+    # classes; docs/STREAMING.md).
+    stream_freshness_slo_ms: float = 0.0
+    # per-priority-class freshness overrides ("prio=ms" pairs; parsed
+    # from the flag above). Requires stream_freshness_slo_ms > 0.
+    stream_freshness_slo_class: str = ""
 
     # -- measured kernel cost table (sys.costs.*; adapm_tpu/ops/
     #    costs.py, docs/PERF.md "Kernel cost table"): per-(variant,
@@ -418,6 +553,49 @@ class SystemOptions:
                 "--sys.serve.slo_ms requires --sys.metrics: the SLO "
                 "controller observes the serve P99 from the "
                 "serve.latency_s histogram and is blind without it")
+        # per-class override specs (ISSUE 20 satellite): parse loudly
+        # here so a malformed "prio=ms" pair fails at parse time / plane
+        # construction, never inside a controller tick
+        parse_class_targets(self.serve_slo_ms, self.serve_slo_class,
+                            flag="--sys.serve.slo_ms")
+        parse_class_targets(self.stream_freshness_slo_ms,
+                            self.stream_freshness_slo_class,
+                            flag="--sys.stream.freshness_slo_ms")
+        if self.flight_freshness_samples < 8:
+            raise ValueError(
+                f"--sys.flight.freshness_samples must be >= 8 "
+                f"(got {self.flight_freshness_samples}): a smaller "
+                f"probe table evicts nearly every probe at the bound — "
+                f"a freshness gauge with no samples behind it")
+        if self.stream_batch < 0:
+            raise ValueError(
+                f"--sys.stream.batch must be >= 0 "
+                f"(got {self.stream_batch}; 0 = no stream trainer)")
+        if self.stream_rate < 0:
+            raise ValueError(
+                f"--sys.stream.rate must be >= 0 "
+                f"(got {self.stream_rate}; 0 = unthrottled)")
+        if self.stream_rate > 0 and self.stream_batch < 1:
+            raise ValueError(
+                "--sys.stream.rate requires --sys.stream.batch >= 1: "
+                "the rate throttles the trainer pump, which does not "
+                "exist without a micro-batch size")
+        if self.stream_freshness_slo_ms < 0:
+            raise ValueError(
+                f"--sys.stream.freshness_slo_ms must be >= 0 "
+                f"(got {self.stream_freshness_slo_ms}; 0 = no "
+                f"freshness controller)")
+        if self.stream_freshness_slo_ms > 0 and not self.trace_flight:
+            raise ValueError(
+                "--sys.stream.freshness_slo_ms requires "
+                "--sys.trace.flight: the freshness controller's sensor "
+                "is the flight plane's push-to-servable probe "
+                "(flight.freshness_s) and is blind without it")
+        if self.stream_freshness_slo_ms > 0 and not self.metrics:
+            raise ValueError(
+                "--sys.stream.freshness_slo_ms requires --sys.metrics: "
+                "the freshness controller reads the flight.freshness_s "
+                "histogram through the registry")
         if self.net_backend not in ("auto", "dcn", "tcp", "loopback"):
             raise ValueError(
                 f"--sys.net.backend must be one of auto/dcn/tcp/"
@@ -693,6 +871,9 @@ class SystemOptions:
                        type=int, default=0)
         g.add_argument("--sys.trace.flight_out",
                        dest="sys_trace_flight_out", default=None)
+        g.add_argument("--sys.flight.freshness_samples",
+                       dest="sys_flight_freshness_samples", type=int,
+                       default=1024)
         g.add_argument("--sys.trace.workload",
                        dest="sys_trace_workload", default=None)
         g.add_argument("--sys.trace.workload_keys",
@@ -716,7 +897,7 @@ class SystemOptions:
                        dest="sys_serve_deadline_ms", type=float,
                        default=0.0)
         g.add_argument("--sys.serve.slo_ms", dest="sys_serve_slo_ms",
-                       type=float, default=0.0)
+                       type=_slo_spec, default="0")
         g.add_argument("--sys.serve.dispatchers",
                        dest="sys_serve_dispatchers", type=int, default=1)
         g.add_argument("--sys.serve.replica_rows",
@@ -726,6 +907,13 @@ class SystemOptions:
                        default=50.0)
         g.add_argument("--sys.serve.bags", dest="sys_serve_bags",
                        type=int, default=1)
+        g.add_argument("--sys.stream.batch", dest="sys_stream_batch",
+                       type=int, default=0)
+        g.add_argument("--sys.stream.rate", dest="sys_stream_rate",
+                       type=float, default=0.0)
+        g.add_argument("--sys.stream.freshness_slo_ms",
+                       dest="sys_stream_freshness_slo_ms",
+                       type=_slo_spec, default="0")
         g.add_argument("--sys.costs.table", dest="sys_costs_table",
                        default=None)
         g.add_argument("--sys.costs.calibrate",
@@ -784,6 +972,10 @@ class SystemOptions:
 
     @classmethod
     def from_args(cls, args: argparse.Namespace) -> "SystemOptions":
+        serve_slo_ms, serve_slo_class = \
+            _split_slo_spec(args.sys_serve_slo_ms)
+        stream_slo_ms, stream_slo_class = \
+            _split_slo_spec(args.sys_stream_freshness_slo_ms)
         opts = cls(
             techniques=MgmtTechniques(args.sys_techniques),
             channels=args.sys_channels,
@@ -838,11 +1030,17 @@ class SystemOptions:
             serve_max_wait_us=args.sys_serve_max_wait_us,
             serve_queue=args.sys_serve_queue,
             serve_deadline_ms=args.sys_serve_deadline_ms,
-            serve_slo_ms=args.sys_serve_slo_ms,
+            serve_slo_ms=serve_slo_ms,
+            serve_slo_class=serve_slo_class,
             serve_dispatchers=args.sys_serve_dispatchers,
             serve_replica_rows=args.sys_serve_replica_rows,
             serve_replica_refresh_ms=args.sys_serve_replica_refresh_ms,
             serve_bags=bool(args.sys_serve_bags),
+            stream_batch=args.sys_stream_batch,
+            stream_rate=args.sys_stream_rate,
+            stream_freshness_slo_ms=stream_slo_ms,
+            stream_freshness_slo_class=stream_slo_class,
+            flight_freshness_samples=args.sys_flight_freshness_samples,
             costs_table=args.sys_costs_table,
             costs_calibrate=bool(args.sys_costs_calibrate),
             fault_spec=args.sys_fault_spec,

@@ -151,7 +151,9 @@ class Server:
         self.flight = None
         if self.opts.trace_flight:
             from ..obs.flight import FlightTracer
-            self.flight = FlightTracer(registry=self.obs, rank=self.pid)
+            self.flight = FlightTracer(
+                registry=self.obs, rank=self.pid,
+                freshness_bound=self.opts.flight_freshness_samples)
         # workload trace capture (ISSUE 15 tentpole; obs/wtrace.py,
         # docs/REPLAY.md): the semantic op stream recorded to a
         # versioned, checksummed .wtrace file for the offline replay
@@ -432,6 +434,31 @@ class Server:
         # ServePlane.__init__ so metrics_snapshot can fold readiness in
         # and shutdown can close it; None until a plane is built
         self._serve_plane = None
+
+        # streaming plane (ISSUE 20 tentpole; adapm_tpu/stream,
+        # docs/STREAMING.md): the acked-event cursor + ingest
+        # accounting + the FreshnessSLO controller closing the loop on
+        # event-to-servable staleness. None unless a --sys.stream.*
+        # knob is set — the r7 skip-wrapper discipline: off costs one
+        # `is None` check per integration site and zero stream.*
+        # registry names (scripts/metrics_overhead_check.py pins it).
+        # Built AFTER the sync manager (the controller's first lever)
+        # and the executor (the controller tick + trainer pump run on
+        # it); started here so a freshness target begins steering
+        # without any further wiring.
+        self.stream = None
+        # cursor recovered from a checkpoint chain that carried
+        # aux_stream_cursor (fault/ckpt.py restore_chain); also applied
+        # to self.stream.cursor when the plane exists — kept as a
+        # separate field so a restore into a plane-less server still
+        # surfaces the watermark loudly instead of dropping it
+        self._restored_stream_cursor: Optional[int] = None
+        if self.opts.stream_batch > 0 or \
+                self.opts.stream_freshness_slo_ms > 0:
+            from ..stream import StreamPlane
+            self.stream = StreamPlane(self)
+        if self.stream is not None:
+            self.stream.start()
 
         # native host-routing core (C++ via ctypes; None -> numpy fallback)
         from ..native import get_lib
@@ -1525,7 +1552,9 @@ class Server:
         every closed plane reads through the pools the later steps block
         on, so readers go down strictly before their substrate:
 
-          1. serve plane (stop admitting lookups; dispatcher drains)
+          1. serve plane (stop admitting lookups; dispatcher drains),
+             then the stream plane (ingest pump drains; freshness
+             controller stops walking sync/replica state)
           2. metrics reporter
           3. prefetch pipeline (staged gathers + delegated rounds)
           4. tier maintenance worker (demotion readbacks)
@@ -1550,6 +1579,11 @@ class Server:
             # stop admitting lookups first: the serve dispatcher reads
             # through the same pools the teardown below blocks on
             self._serve_plane.close()
+        if self.stream is not None:
+            # stream plane next: the ingest pump pushes through the
+            # live pools (its `stream` stream drains inside close) and
+            # the freshness tick walks sync/replica state
+            self.stream.close()
         if self._reporter is not None:
             self._reporter.stop()
             self._reporter = None
@@ -1659,7 +1693,7 @@ class Server:
                           "serve", "tier", "exec", "flight", "slo",
                           "fault", "ckpt", "device", "episode",
                           "wtrace", "replay", "decision", "policy",
-                          "net")
+                          "net", "stream")
 
     def metrics_snapshot(self, drain_device: bool = True) -> Dict:
         """One structured, JSON-serializable telemetry dict for this
@@ -1818,8 +1852,19 @@ class Server:
         tallies, and failover record (`failovers`, `failover_s`,
         `promoted_keys`, `lost_keys`); `{}` on single-process and
         legacy-DCN servers (no plane object, zero net.* names —
-        metrics_overhead_check.py pins default-off)."""
-        out: Dict = {"schema_version": 15,
+        metrics_overhead_check.py pins default-off).
+
+        schema_version 16 (PR 20): always-present `stream` section
+        (ISSUE 20; adapm_tpu/stream) — the streaming plane's ingest
+        accounting (acked-event `cursor`, `events_total` /
+        `batches_total` / `acked_events_total` /
+        `replayed_events_total`), the trainer's resume/batch/rate
+        stats, and — with `--sys.stream.freshness_slo_ms` — the
+        FreshnessSLO controller report (effective target, lever
+        positions vs their static knobs, adjustment log); `{}` when no
+        `--sys.stream.*` knob is set (no plane object, zero stream.*
+        names — metrics_overhead_check.py pins default-off)."""
+        out: Dict = {"schema_version": 16,
                      "metrics_enabled": bool(self.obs.enabled)}
         for s in self._SNAPSHOT_SECTIONS:
             out[s] = {}
@@ -1864,6 +1909,8 @@ class Server:
                      for k, v in self.glob.coll.stats.items()})
         if self.net is not None:
             out["net"].update(self.net.stats())
+        if self.stream is not None:
+            out["stream"].update(self.stream.stats())
         if self.spans is not None:
             out["spans"].update(self.spans.stats())
         # executor occupancy/overlap summary rides with the registry's

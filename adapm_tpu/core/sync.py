@@ -217,6 +217,14 @@ class SyncManager:
     def __init__(self, server, opts):
         self.server = server
         self.opts = opts
+        # the EFFECTIVE sync-rate bound _throttle honors (ISSUE 20):
+        # initialized from the static --sys.sync.max_per_sec knob and —
+        # only when a FreshnessSLO controller is live — walked ABOVE it
+        # so sync rounds run more often than the static throttle
+        # allows, then relaxed back toward it. With no controller
+        # nothing ever writes this, so throttling is byte-identical to
+        # the static-knob path. <= 0 keeps meaning unthrottled.
+        self.effective_max_per_sec = float(opts.sync_max_per_sec)
         self.num_channels = opts.channels
         S = server.num_shards
         K = server.num_keys
@@ -821,9 +829,9 @@ class SyncManager:
         if self.opts.sync_pause_ms > 0:
             time.sleep(self.opts.sync_pause_ms / 1e3)
             return
-        if self.opts.sync_max_per_sec <= 0:
+        if self.effective_max_per_sec <= 0:
             return
-        min_gap = 1.0 / self.opts.sync_max_per_sec
+        min_gap = 1.0 / self.effective_max_per_sec
         now = time.monotonic()
         wait = self._last_round_t + min_gap - now
         if wait > 0:

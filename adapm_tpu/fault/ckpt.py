@@ -147,11 +147,21 @@ class IncrementalCheckpointer:
     def _aux_arrays(self) -> Dict[str, np.ndarray]:
         srv = self.server
         ab = srv.ab
-        return {"owner": ab.owner, "slot": ab.slot,
-                "cache_slot": ab.cache_slot,
-                "relocation_counter": ab.relocation_counter,
-                "intent_end": srv.sync.intent_end,
-                "clocks": srv._clocks}
+        out = {"owner": ab.owner, "slot": ab.slot,
+               "cache_slot": ab.cache_slot,
+               "relocation_counter": ab.relocation_counter,
+               "intent_end": srv.sync.intent_end,
+               "clocks": srv._clocks}
+        # streaming plane (ISSUE 20): the acked-event cursor rides the
+        # chain so a restore lands on "events [0, cursor) applied
+        # exactly once". Captured under the SAME lock hold as the row
+        # bits, and — like the trainer's cursor bump — never torn
+        # against a push: both sides bracket with the server RLock.
+        # Optional: pre-v16 chains (and stream-off servers) simply
+        # never carry it, so it is NOT in _AUX_KEYS' mandatory set.
+        if getattr(srv, "stream", None) is not None:
+            out["stream_cursor"] = srv.stream.cursor
+        return out
 
     def _capture_locked(self, kind: str):
         """Assemble one link's arrays (caller holds the server lock).
@@ -518,6 +528,11 @@ def _apply_chain(server, chain: List[Tuple[Dict, Dict]]) -> None:
             k = f"aux_{name}"
             if k in arrs:
                 aux[name] = arrs[k]
+        # optional stream cursor (ISSUE 20): collected when present,
+        # never required — pre-v16 chains and stream-off servers have
+        # no aux_stream_cursor and must keep restoring cleanly
+        if "aux_stream_cursor" in arrs:
+            aux["stream_cursor"] = arrs["aux_stream_cursor"]
     missing = [n for n in _AUX_KEYS if n not in aux]
     if missing:
         raise CheckpointChainError(
@@ -539,6 +554,16 @@ def _apply_chain(server, chain: List[Tuple[Dict, Dict]]) -> None:
         server._clocks[:] = aux["clocks"]
         for wid, w in server._workers.items():
             w._clock = int(server._clocks[wid])
+        if "stream_cursor" in aux:
+            # acked-event horizon (ISSUE 20): recorded on the server
+            # regardless of plane state, and written into the live
+            # plane when one exists — a resumed StreamTrainer starts
+            # from here and replay_tail() re-applies only the tail
+            # between this and the pre-kill ack watermark
+            cur = int(np.asarray(aux["stream_cursor"]).reshape(-1)[0])
+            server._restored_stream_cursor = cur
+            if getattr(server, "stream", None) is not None:
+                server.stream.cursor[0] = cur
 
         rep_sh, rep_k = np.nonzero(ab.cache_slot >= 0)
         for cid, st in enumerate(server.stores):

@@ -257,7 +257,7 @@ class NoBlockingUnderLockRule(Rule):
 # form, which this rule never flags).
 OPTIONAL_HANDLES = frozenset({
     "fault", "flight", "tracer", "slo", "tier", "prefetch", "recorder",
-    "wtrace", "decisions", "policy",
+    "wtrace", "decisions", "policy", "stream",
 })
 
 # metric-registry factory methods (import-time registration ban)

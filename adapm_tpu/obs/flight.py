@@ -180,7 +180,8 @@ class FlightTracer:
     slices are counted as dropped."""
 
     def __init__(self, registry=None, rank: int = 0,
-                 max_slices: int = 200_000):
+                 max_slices: int = 200_000,
+                 freshness_bound: int = 1024):
         from .metrics import (Counter, Histogram,
                               SERVE_LATENCY_BOUNDS_S)
         self.rank = rank
@@ -198,7 +199,11 @@ class FlightTracer:
         # (name, tid_key, t0, t1, ids, args) — tid_key is a real thread
         # ident (int) or a virtual-track name (str)
         self._slices: List[Tuple] = []
-        self.freshness = FreshnessProbe(registry)
+        # probe-table bound: --sys.flight.freshness_samples (ISSUE 20
+        # satellite — the streaming controller samples this histogram
+        # every tick, so the table must be deep enough that the hot
+        # head's probes aren't all evicted between serve reads)
+        self.freshness = FreshnessProbe(registry, bound=freshness_bound)
         use_reg = registry is not None and registry.enabled
 
         def _hist(name):

@@ -57,6 +57,7 @@ class SLOController:
     closed by the plane."""
 
     def __init__(self, server, batcher, target_ms: float,
+                 class_targets: Optional[Dict[int, float]] = None,
                  interval_s: float = 0.1, tol: float = 0.25,
                  step: float = 1.5, min_samples: int = 4,
                  quantile: float = 0.99):
@@ -97,6 +98,30 @@ class SLOController:
         self.g_target = reg.gauge("slo.target_ms", shared=True)
         self.g_target.set(float(target_ms))
         self.g_wait.set(float(batcher.max_wait_us))
+        # per-priority-class targets (ISSUE 20 satellite;
+        # `--sys.serve.slo_ms 20,1=5,2=50`): each overridden class gets
+        # its OWN effective lane window, walked by the same law against
+        # that class's windowed quantile. Batches are priority-pure
+        # (admission.take pins the class after the first claim), so a
+        # class's window is well-defined per batch; the base window
+        # still serves classes without an override. Empty (the default)
+        # touches nothing — the batcher's class hooks stay None and the
+        # take() path is byte-identical.
+        self.class_targets_s: Dict[int, float] = {
+            int(p): float(ms) * 1e-3
+            for p, ms in (class_targets or {}).items()}
+        self.class_adjustments: "collections.deque" = \
+            collections.deque(maxlen=256)
+        self._class_prev_cut: Optional[float] = None
+        self.class_hi_us: Dict[int, int] = {}
+        if self.class_targets_s:
+            base = int(batcher.max_wait_us)
+            batcher.class_wait_us = {p: base
+                                     for p in self.class_targets_s}
+            batcher._class_samples = collections.deque(maxlen=4096)
+            self.class_hi_us = {
+                p: max(base, int(ts * 1e6 * 0.75))
+                for p, ts in self.class_targets_s.items()}
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -125,6 +150,8 @@ class SLOController:
             return
         try:
             self._control()
+            if self.class_targets_s:
+                self._control_classes()
         finally:
             self._resubmit()
 
@@ -204,6 +231,55 @@ class SLOController:
             dc.record_serve(cur, new, p99 * 1e3, self.target_s * 1e3,
                             lambda: float(self.g_p99.value))
 
+    def _control_classes(self) -> None:
+        """Walk each overridden class's lane window against its own
+        windowed quantile (the batcher's bounded (t, latency, prio)
+        sample ring — per-class percentiles without per-class registry
+        names). Same law, same deadband, same bounds discipline as the
+        base window; moves land in `class_adjustments` and count into
+        `slo.adjustments_total`."""
+        samples = self.batcher._class_samples
+        cw = self.batcher.class_wait_us
+        if samples is None or cw is None:
+            return
+        now = time.perf_counter()  # the sample stamps' clock
+        cut = self._class_prev_cut
+        self._class_prev_cut = now
+        if cut is None:
+            return
+        by_prio: Dict[int, List[float]] = {}
+        for (t, lat, prio) in list(samples):
+            if t > cut and prio in self.class_targets_s:
+                by_prio.setdefault(prio, []).append(lat)
+        for prio in sorted(self.class_targets_s):
+            target_s = self.class_targets_s[prio]
+            lats = by_prio.get(prio)
+            if lats is None or len(lats) < self.min_samples:
+                continue
+            lats.sort()
+            p99 = lats[min(len(lats) - 1,
+                           int(self.quantile * len(lats)))]
+            cur = int(cw.get(prio, self.batcher.max_wait_us))
+            hi = self.class_hi_us[prio]
+            if p99 > target_s * (1.0 + self.tol):
+                if cur <= self.lo_us:
+                    continue
+                new = max(self.lo_us, min(cur - 1, int(cur / self.step)))
+            elif p99 < target_s * (1.0 - self.tol):
+                if cur >= hi:
+                    continue
+                new = min(hi, max(cur + _MIN_GROW_US,
+                                  int(cur * self.step)))
+            else:
+                continue  # deadband
+            if new == cur:
+                continue
+            cw[prio] = new
+            self.c_adjust.inc()
+            self.class_adjustments.append(
+                (time.time(), time.monotonic(), prio, cur, new,
+                 p99 * 1e3))
+
     # -- reporting -----------------------------------------------------------
 
     def report(self) -> Dict:
@@ -218,10 +294,27 @@ class SLOController:
             t, tm, o, n, p = self.first_adjustment
             first = {"t": round(t, 3), "t_mono": round(tm, 6),
                      "old_us": o, "new_us": n, "p99_ms": round(p, 3)}
-        return {"active": True,
-                "target_ms": round(self.target_s * 1e3, 3),
-                "wait_us": int(self.batcher.max_wait_us),
-                "bounds_us": [self.lo_us, self.hi_us],
-                "adjustments": int(self.c_adjust.value),
-                "first_adjustment": first,
-                "recent_adjustments": last}
+        out = {"active": True,
+               "target_ms": round(self.target_s * 1e3, 3),
+               "wait_us": int(self.batcher.max_wait_us),
+               "bounds_us": [self.lo_us, self.hi_us],
+               "adjustments": int(self.c_adjust.value),
+               "first_adjustment": first,
+               "recent_adjustments": last}
+        if self.class_targets_s:
+            # per-class keys present ONLY with class overrides — the
+            # no-override report (and every pre-existing consumer of
+            # it) is byte-identical
+            cw = self.batcher.class_wait_us or {}
+            out["class_targets_ms"] = {
+                str(p): round(ts * 1e3, 3)
+                for p, ts in sorted(self.class_targets_s.items())}
+            out["class_wait_us"] = {str(p): int(w)
+                                    for p, w in sorted(cw.items())}
+            out["class_adjustments"] = [
+                {"t": round(t, 3), "t_mono": round(tm, 6),
+                 "priority": pr, "old_us": o, "new_us": n,
+                 "p99_ms": round(p, 3)}
+                for (t, tm, pr, o, n, p)
+                in list(self.class_adjustments)[-8:]]
+        return out

@@ -131,6 +131,15 @@ def _build_opts(trace: WorkloadTrace, overrides: Optional[Dict]):
     opts.ckpt_every_s = 0.0
     opts.ckpt_path = None
     opts.heartbeat_s = 0.0
+    # streaming plane (ISSUE 20): the captured run's ingest pump and
+    # freshness controller are live timer loops, and every push they
+    # issued is ALREADY in the op stream being re-driven — a replayed
+    # server must not ingest the events a second time (and the
+    # controller's sensor, trace_flight, is off above anyway)
+    opts.stream_batch = 0
+    opts.stream_rate = 0.0
+    opts.stream_freshness_slo_ms = 0.0
+    opts.stream_freshness_slo_class = ""
     num_shards = int(trace.meta.get("num_shards", 0)) or None
     for k, v in dict(overrides or {}).items():
         if k == "num_shards":  # engine-level: the capacity-sim knob

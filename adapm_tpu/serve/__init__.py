@@ -81,9 +81,17 @@ class ServePlane:
         # path is untouched (the module is not even imported)
         self.slo = None
         if opts.serve_slo_ms > 0:
+            from ..config import parse_class_targets
             from ..obs.slo import SLOController
+            # per-priority-class overrides (ISSUE 20 satellite;
+            # `--sys.serve.slo_ms 20,1=5`): validated at parse time,
+            # re-parsed here into {priority: target_ms}
+            cls = parse_class_targets(opts.serve_slo_ms,
+                                      opts.serve_slo_class,
+                                      flag="--sys.serve.slo_ms")
             self.slo = SLOController(server, self.batcher,
-                                     target_ms=opts.serve_slo_ms)
+                                     target_ms=opts.serve_slo_ms,
+                                     class_targets=cls)
         server._serve_plane = self
         if start:
             self.start()

@@ -550,7 +550,8 @@ class AdmissionQueue:
         return None
 
     def take(self, max_batch: int, max_wait_s: float,
-             block: bool = True, lane: int = 0):
+             block: bool = True, lane: int = 0,
+             wait_s_by_prio: Optional[Dict[int, float]] = None):
         """Claim up to `max_batch` live requests from `lane`: wait for
         the first (`block=False` — the executor-driven drain — returns
         [] immediately instead, since a kick already guarantees a
@@ -558,7 +559,16 @@ class AdmissionQueue:
         `max_wait_s` to coalesce more (the micro-batch window). Safe
         for N concurrent callers (disjoint claims by the state
         machine). Returns [] when there is nothing to claim (closed
-        queue, or empty with block=False)."""
+        queue, or empty with block=False).
+
+        `wait_s_by_prio` (ISSUE 20 satellite; per-class SLO targets)
+        overrides the linger window per priority CLASS: batches are
+        priority-pure (the `prio` pin below), so once the first claim
+        fixes the batch's class, that class's window — walked
+        independently by the SLO controller — replaces `max_wait_s`.
+        Classes without an override keep the base window; None (the
+        default, and the only value without `--sys.serve.slo_ms`
+        class overrides) leaves this path byte-identical."""
         dq = self._lanes[lane % self.lanes]
         taken: Dict[str, int] = {}
         with self._cond:
@@ -571,6 +581,8 @@ class AdmissionQueue:
                 self._cond.wait()
             out = [first]
             prio = first.priority if self._has_qos else None
+            if wait_s_by_prio is not None and prio is not None:
+                max_wait_s = wait_s_by_prio.get(prio, max_wait_s)
             if max_wait_s > 0 and len(out) < max_batch:
                 limit = time.monotonic() + max_wait_s
                 while len(out) < max_batch and not self._closed:

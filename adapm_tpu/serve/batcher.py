@@ -48,9 +48,10 @@ tests, replica path included).
 """
 from __future__ import annotations
 
+import collections
 import itertools
 import time
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 import numpy as np
 
@@ -102,6 +103,16 @@ class LookupBatcher:
         # With no SLO target nothing ever writes it, so the static-knob
         # path behaves exactly as before
         self.max_wait_us = int(opts.serve_max_wait_us)
+        # per-priority-class effective windows (ISSUE 20 satellite;
+        # --sys.serve.slo_ms class overrides). None — the default, and
+        # the ONLY value without overrides — keeps the take() path
+        # byte-identical; set by ServePlane to {prio: wait_us}, each
+        # entry walked independently by the SLO controller. The
+        # bounded sample ring feeds the controller's per-class
+        # percentiles (plain (t_mono, latency_s, prio) tuples — no
+        # dynamic per-class registry names, APM007 stays closed).
+        self.class_wait_us: Optional[Dict[int, int]] = None
+        self._class_samples: Optional[collections.deque] = None
         self._running = False
         reg = server.obs
         # shared=True: a plane rebuilt on the same server reuses the
@@ -254,8 +265,12 @@ class LookupBatcher:
             # re-read per batch: the SLO controller adapts max_wait_us
             # between batches and the next window must honor it
             max_wait_s = self.max_wait_us * 1e-6
-            reqs = self.queue.take(max_batch, max_wait_s, block=False,
-                                   lane=lane)
+            cw = self.class_wait_us
+            reqs = self.queue.take(
+                max_batch, max_wait_s, block=False, lane=lane,
+                wait_s_by_prio=(
+                    {p: w * 1e-6 for p, w in cw.items()}
+                    if cw is not None else None))
             if not reqs:
                 return  # empty (or closed): park until the next kick
             self._busy_since[lane] = time.monotonic()
@@ -419,6 +434,9 @@ class LookupBatcher:
             if r.tenant is not None:
                 r.tenant.c_served.inc()
             self.h_latency.observe(now - r.t0)
+            cs = self._class_samples
+            if cs is not None:
+                cs.append((now, now - r.t0, r.priority))
 
     def _lookup_union(self, keys: np.ndarray, after):
         """One coalesced pull of the (unique, sorted) union batch — the
@@ -541,6 +559,9 @@ class LookupBatcher:
             if r.tenant is not None:
                 r.tenant.c_served.inc()
             self.h_latency.observe(now - r.t0)
+            cs = self._class_samples
+            if cs is not None:
+                cs.append((now, now - r.t0, r.priority))
 
     def _lookup_bags_fused(self, groups):
         """Dispatch one fused gather_pool per (length class, pooling)

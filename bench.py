@@ -1120,6 +1120,54 @@ def bench_replay(E=8_000, vlen=16, steps=120, skew=8.0):
                               for n in candidates}}
 
 
+def bench_northstar(E=8192, vlen=16, batch=32, rate=2000.0,
+                    segment_s=3.0):
+    """North-star phase (ISSUE 20): the train-while-serve streaming
+    scenario (adapm_tpu/stream/scenario.py) — continuous event ingest
+    + multi-tenant `lookup_bags` serving + periodic incremental
+    checkpoints + a mid-stream kill/restore drill + the FreshnessSLO
+    closed loop — then the captured `.wtrace` replayed TWICE to pin
+    the determinism digest. The artifact carries events/s, served
+    P50/P99, trailing-window freshness P50/P99 (the number ISSUE 20's
+    acceptance compares against r18's uncontrolled 3.19 s P99),
+    recovery_s, and the drill's replay accounting."""
+    import tempfile
+
+    from adapm_tpu.replay import ReplayEngine, load_wtrace
+    from adapm_tpu.stream.scenario import run_northstar
+
+    with tempfile.TemporaryDirectory(prefix="adapm_northstar_") as tmp:
+        _progress(f"northstar phase: running scenario ({E} keys, "
+                  f"2 x {segment_s}s segments)")
+        out = run_northstar(num_keys=E, vlen=vlen, batch=batch,
+                            rate=rate, segment_s=segment_s,
+                            workdir=tmp)
+        # canonical-wtrace determinism (ISSUE 20 satellite): the
+        # captured stream replays to the SAME reads digest twice —
+        # the full sweep guard is scripts/trace_replay_check.py; this
+        # pins the northstar capture specifically
+        tr = load_wtrace(out["wtrace_path"])
+        _progress(f"northstar phase: replaying {len(tr.events)} "
+                  "captured events twice")
+        r1 = ReplayEngine(tr, seed=7, speed=100.0).run()
+        r2 = ReplayEngine(tr, seed=7, speed=100.0).run()
+        out["wtrace"] = {
+            "events": len(tr.events),
+            "kinds": tr.kinds(),
+            "reads_digest": r1["reads_digest"],
+            "replay_deterministic":
+                bool(r1["reads_digest"] == r2["reads_digest"])}
+        out["wtrace_path"] = None   # tempdir-bound; shape stays stable
+    fr = out["freshness"]
+    _progress(f"northstar phase: {out['events_per_sec']} events/s, "
+              f"served p99 {out['served_p99_ms']} ms, freshness p99 "
+              f"{fr['p99_ms']} ms (target {fr['target_ms']} ms), "
+              f"recovery {out['drill']['recovery_s']}s, "
+              f"{out['drill']['replayed_events']} replayed, "
+              f"deterministic={out['wtrace']['replay_deterministic']}")
+    return out
+
+
 def bench_policy(E=1024, vlen=8, steps=80, skew=6.0):
     """Learned-policy phase (ISSUE 18): capture the decision plane
     under a deliberately starved hot pool (promotion under churn
@@ -1850,6 +1898,18 @@ def _phase_replay():
     return out
 
 
+def _phase_northstar():
+    import jax
+    sz = {"E": 2_048, "vlen": 8, "batch": 16, "rate": 1000.0,
+          "segment_s": 2.0} \
+        if os.environ.get("ADAPM_BENCH_SMALL") else {}
+    out = bench_northstar(**sz)
+    out["virtual_shards"] = len(jax.devices("cpu"))
+    if sz:
+        out["small_sizes"] = sz
+    return out
+
+
 def _phase_policy():
     import jax
     sz = {"steps": 60} if os.environ.get("ADAPM_BENCH_SMALL") else {}
@@ -1988,6 +2048,7 @@ _PHASES = {"probe": _phase_probe, "kge": _phase_kge,
            "fault": _phase_fault, "net": _phase_net,
            "replay": _phase_replay,
            "policy": _phase_policy,
+           "northstar": _phase_northstar,
            "w2v": _phase_w2v, "cpu": _phase_cpu}
 
 # generous per-phase walls: a healthy phase finishes in a fraction of
@@ -1997,6 +2058,7 @@ _TIMEOUTS = {"probe": 120, "kge": 1200, "prefetch": 1200, "scan": 900,
              "serve": 900, "bag": 900, "tier": 900, "exec": 900,
              "episodic": 900,
              "fault": 900, "net": 900, "replay": 900, "policy": 900,
+             "northstar": 900,
              "w2v": 900, "cpu": 600}
 
 _CPU_ENV = {"JAX_PLATFORMS": "cpu", "ADAPM_PLATFORM": "cpu",

@@ -162,6 +162,21 @@ def main() -> int:
     print("[overhead-check] net transport plane default-off: no "
           "membership plane, zero net.* names; the dcn/legacy path is "
           "byte-identical")
+    # ISSUE 20: the streaming plane is compiled in but DEFAULT OFF —
+    # with no --sys.stream.* knobs set no StreamPlane object exists,
+    # zero stream.* registry names, and the snapshot `stream` section
+    # stays empty. The checkpoint aux writer and Server.shutdown each
+    # pay one `is None` check; the unchanged median-ratio guard below
+    # times the pull/push hot path with those branches present.
+    assert srv.stream is None, \
+        "streaming plane must be DEFAULT OFF (--sys.stream.batch 0, " \
+        "--sys.stream.freshness_slo_ms 0)"
+    stream_names = [n for n in names if n.startswith("stream.")]
+    assert not stream_names, \
+        f"default-off streaming plane registered metrics: {stream_names}"
+    print("[overhead-check] streaming plane default-off: no "
+          "StreamPlane, zero stream.* names; the ingest/freshness "
+          "hooks are zero-cost skips")
     saved = (w._h_pull, w._h_push, w._h_set, srv.sync._h_round)
     probe(w, batches, vals, 30)  # warm the jit caches
     # per-pair (off, on) timings back to back; the guard is the MEDIAN

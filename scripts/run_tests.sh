@@ -101,5 +101,12 @@ python scripts/policy_gate_check.py
 # to mains within the bounded, recorded net.failover_s and the survivor
 # must keep serving the covered keys bit-exactly
 python scripts/net_storm_check.py
+# freshness-SLO guard (ISSUE 20): with --sys.stream.freshness_slo_ms
+# set tight against lazy static knobs (250 ms replica refresh, 2/s
+# sync), the closed-loop controller must walk the levers in the
+# correct direction on its first move and land the trailing-window
+# event-to-servable freshness P99 within the tolerance band of the
+# target (median of trailing windows; ADAPM_FRESHNESS_BAND)
+python scripts/freshness_slo_check.py
 python -m pytest tests/ -q "$@"
 echo "ALL TESTS PASSED"

@@ -605,3 +605,88 @@ def test_net_knobs_round_trip_and_rejection():
         SystemOptions(net_backend="ipx").validate_serve()
     with pytest.raises(ValueError, match="net.queue"):
         SystemOptions(net_queue=-1).validate_serve()
+
+
+def test_stream_knobs_round_trip_and_rejection():
+    """--sys.stream.* and --sys.flight.freshness_samples parse into
+    the options the streaming plane consumes, and inconsistent
+    combinations fail loudly at parse time (ISSUE 20)."""
+    import argparse
+
+    import pytest
+
+    from adapm_tpu.config import SystemOptions
+    p = argparse.ArgumentParser()
+    SystemOptions.add_arguments(p)
+    dflt = SystemOptions.from_args(p.parse_args([]))
+    # all DEFAULT OFF: no plane, zero stream.* names
+    assert (dflt.stream_batch, dflt.stream_rate,
+            dflt.stream_freshness_slo_ms,
+            dflt.stream_freshness_slo_class) == (0, 0.0, 0.0, "")
+    assert dflt.flight_freshness_samples == 1024
+    on = SystemOptions.from_args(p.parse_args(
+        ["--sys.stream.batch", "32", "--sys.stream.rate", "2000",
+         "--sys.stream.freshness_slo_ms", "400,1=200",
+         "--sys.trace.flight", "1",
+         "--sys.flight.freshness_samples", "64"]))
+    assert on.stream_batch == 32 and on.stream_rate == 2000.0
+    # the flag carries "base,prio=ms,..." — split at parse time
+    assert on.stream_freshness_slo_ms == 400.0
+    assert on.stream_freshness_slo_class == "1=200"
+    assert on.flight_freshness_samples == 64
+    bad = (["--sys.stream.batch", "-1"],
+           ["--sys.stream.rate", "-2"],
+           # rate needs a batch to pace
+           ["--sys.stream.rate", "100"],
+           ["--sys.stream.freshness_slo_ms", "-5"],
+           # the controller without its sensor / its registry
+           ["--sys.stream.freshness_slo_ms", "50"],
+           ["--sys.stream.freshness_slo_ms", "50",
+            "--sys.trace.flight", "1", "--sys.metrics", "0"],
+           # probe bound floor
+           ["--sys.flight.freshness_samples", "4"],
+           # per-class semantics: dup class / non-positive target
+           ["--sys.stream.freshness_slo_ms", "400,1=200,1=100",
+            "--sys.trace.flight", "1"],
+           ["--sys.stream.freshness_slo_ms", "400,1=-5",
+            "--sys.trace.flight", "1"])
+    for argv in bad:
+        with pytest.raises(ValueError):
+            SystemOptions.from_args(p.parse_args(argv))
+    # malformed class SYNTAX is rejected by argparse itself
+    with pytest.raises(SystemExit):
+        p.parse_args(["--sys.stream.freshness_slo_ms", "400,x=oops"])
+    # hand-built options are validated again at plane construction
+    with pytest.raises(ValueError, match="stream.rate"):
+        SystemOptions(stream_rate=100.0).validate_serve()
+    with pytest.raises(ValueError, match="freshness_samples"):
+        SystemOptions(flight_freshness_samples=2).validate_serve()
+
+
+def test_serve_slo_class_spec_round_trip_and_rejection():
+    """--sys.serve.slo_ms accepts per-priority-class overrides
+    ("20,1=5"); the no-override spec stays byte-identical (ISSUE 20
+    satellite)."""
+    import argparse
+
+    import pytest
+
+    from adapm_tpu.config import SystemOptions, parse_class_targets
+    p = argparse.ArgumentParser()
+    SystemOptions.add_arguments(p)
+    plain = SystemOptions.from_args(p.parse_args(
+        ["--sys.serve.slo_ms", "20"]))
+    assert plain.serve_slo_ms == 20.0 and plain.serve_slo_class == ""
+    assert parse_class_targets(plain.serve_slo_ms,
+                               plain.serve_slo_class) == {}
+    on = SystemOptions.from_args(p.parse_args(
+        ["--sys.serve.slo_ms", "20,1=5,0=50"]))
+    assert on.serve_slo_ms == 20.0 and on.serve_slo_class == "1=5,0=50"
+    assert parse_class_targets(on.serve_slo_ms, on.serve_slo_class) \
+        == {1: 5.0, 0: 50.0}
+    # overrides demand a base target; negative classes are rejected
+    with pytest.raises(ValueError):
+        parse_class_targets(0.0, "1=5")
+    with pytest.raises(ValueError):
+        SystemOptions.from_args(p.parse_args(
+            ["--sys.serve.slo_ms", "20,-1=5"]))
